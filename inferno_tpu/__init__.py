@@ -1,0 +1,30 @@
+"""inferno_tpu — TPU-native workload-variant autoscaler.
+
+A ground-up TPU rebuild of the capability surface of
+llm-d-incubation/inferno-autoscaler (the "Workload-Variant-Autoscaler"):
+an SLO-aware, cost-optimal control plane that decides, for every LLM
+inference variant it manages, *which TPU slice shape* (v5e-4, v5e-16,
+v5p-8, ...) and *how many pod-slice replicas* are needed to meet
+TTFT/ITL/TPS service targets at minimum cost — and publishes that
+decision for an external actuator (HPA/KEDA) to enact.
+
+Package layout:
+  config/    — serializable system spec: TPU slice catalog, model perf
+               profiles, service classes, servers, optimizer settings
+  analyzer/  — queueing theory: state-dependent M/M/1/K batch-service
+               model, scalar reference implementation (numpy, log-space)
+  ops/       — the same math batched and jitted with JAX for TPU: one
+               fused solve for the whole fleet instead of per-pair loops
+  core/      — domain objects: System, Server, Allocation sizing
+  solver/    — allocation assignment: unlimited + greedy w/ priorities
+  models/    — performance models: linear profiles, profile fitting,
+               learned latency surrogate (flax)
+  parallel/  — jax.sharding mesh utilities; sharded fleet solve and
+               surrogate training step
+  controller/— Kubernetes reconcile loop, Prometheus collector, actuator
+  emulator/  — JetStream/vLLM-TPU inference-server emulator + load gen
+"""
+
+from inferno_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,28 @@
+from inferno_tpu.analyzer.queue import (
+    AnalysisMetrics,
+    AnalyzerError,
+    QueueAnalyzer,
+    QueueStats,
+    TargetPerf,
+    TargetRate,
+    build_analyzer,
+    effective_concurrency,
+    service_rates,
+    solve_birth_death,
+)
+from inferno_tpu.analyzer.sizing import BisectionResult, bisect_monotone
+
+__all__ = [
+    "AnalysisMetrics",
+    "AnalyzerError",
+    "QueueAnalyzer",
+    "QueueStats",
+    "TargetPerf",
+    "TargetRate",
+    "build_analyzer",
+    "effective_concurrency",
+    "service_rates",
+    "solve_birth_death",
+    "BisectionResult",
+    "bisect_monotone",
+]

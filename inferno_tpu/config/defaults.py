@@ -1,0 +1,47 @@
+"""System-wide defaults and enums.
+
+Capability parity with the reference's tuning constants
+(/root/reference/pkg/config/defaults.go:12-33 and
+/root/reference/pkg/config/config.go:4-41), re-expressed for the TPU
+domain where noted.
+"""
+
+import enum
+
+# Percentile assumed when SLO targets are interpreted against average-value
+# queueing statistics (reference: pkg/config/defaults.go:12).
+SLO_PERCENTILE = 0.95
+
+# Multiplier applied to average statistics to approximate the SLO percentile
+# under an exponential-tail assumption (reference: pkg/config/defaults.go:15).
+SLO_MARGIN = 3.0
+
+# Maximum queue length as a multiple of the max batch size
+# (reference: pkg/config/defaults.go:18).
+MAX_QUEUE_TO_BATCH_RATIO = 10
+
+# Penalty factor applied when an optimization decision moves a server between
+# slice shapes. Re-provisioning a TPU pod-slice (multi-host, atomically
+# scheduled) is substantially more disruptive than adding a replica on the
+# same shape, so transitions are taxed (reference: pkg/config/defaults.go:21).
+ACCEL_PENALTY_FACTOR = 0.1
+
+# Fraction of maximum stable throughput held back as safety headroom when a
+# TPS target is active (reference: pkg/analyzer/queueanalyzer.go:11).
+STABILITY_SAFETY_FRACTION = 0.1
+
+# Service class fallbacks (reference: pkg/config/defaults.go:24-33).
+DEFAULT_SERVICE_CLASS_NAME = "Free"
+DEFAULT_SERVICE_CLASS_PRIORITY = 100
+MIN_PRIORITY = 1  # highest priority (lower value = higher priority)
+MAX_PRIORITY = 100  # lowest priority
+
+
+class SaturationPolicy(str, enum.Enum):
+    """Best-effort allocation policy when chip capacity cannot satisfy all
+    SLOs (reference: pkg/config/config.go:4-41)."""
+
+    NONE = "None"
+    PRIORITY_EXHAUSTIVE = "PriorityExhaustive"
+    PRIORITY_ROUND_ROBIN = "PriorityRoundRobin"
+    ROUND_ROBIN = "RoundRobin"

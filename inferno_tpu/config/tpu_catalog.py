@@ -1,0 +1,116 @@
+"""TPU slice-shape catalog.
+
+The reference models accelerators as {type, multiplicity} card bundles
+(/root/reference/pkg/config/types.go:29-37). On TPU the natural allocation
+unit is a *slice*: a contiguous block of chips connected by ICI, scheduled
+atomically across `chips/chips_per_host` hosts. A "replica" of an inference
+server is one pod-slice; capacity is counted in chips per generation pool;
+feasible shapes are constrained by the ICI torus topology of each
+generation.
+
+This catalog is data, not code: deployments can extend it via the
+accelerator ConfigMap; these entries are the built-in shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Host granularity: one v5e/v5p/v6e host exposes 4 chips; multi-host slices
+# scale in whole-host increments. This is the TPU analogue of the reference's
+# capacity arithmetic in units × multiplicity (pkg/core/system.go:296).
+CHIPS_PER_HOST = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceShape:
+    """A feasible TPU slice: generation + ICI topology."""
+
+    name: str  # e.g. "v5e-16"
+    generation: str  # capacity pool: "v5e", "v5p", "v6e"
+    topology: str  # ICI torus, e.g. "4x4" or "2x2x2"
+    chips: int  # chips in the slice
+
+    @property
+    def hosts(self) -> int:
+        """Whole hosts occupied (multi-host slices scale atomically)."""
+        return max(1, self.chips // CHIPS_PER_HOST)
+
+    @property
+    def multi_host(self) -> bool:
+        return self.hosts > 1
+
+    @property
+    def ici_links(self) -> int:
+        """Approximate count of ICI links in the torus (used only as a
+        relative interconnect-richness signal, not a performance model)."""
+        dims = [int(d) for d in self.topology.split("x")]
+        links = 0
+        for i, d in enumerate(dims):
+            other = 1
+            for j, e in enumerate(dims):
+                if j != i:
+                    other *= e
+            # wrap-around links only exist for dims >= 3 on a torus
+            per_dim = d if d >= 3 else d - 1
+            links += per_dim * other
+        return links
+
+
+def _v5e(chips: int, topology: str) -> SliceShape:
+    return SliceShape(f"v5e-{chips}", "v5e", topology, chips)
+
+
+def _v5p(chips: int, topology: str) -> SliceShape:
+    return SliceShape(f"v5p-{chips}", "v5p", topology, chips)
+
+
+def _v6e(chips: int, topology: str) -> SliceShape:
+    return SliceShape(f"v6e-{chips}", "v6e", topology, chips)
+
+
+# Feasible shapes per generation (2D torus for v5e/v6e, 3D for v5p).
+TPU_SLICE_CATALOG: dict[str, SliceShape] = {
+    s.name: s
+    for s in [
+        _v5e(1, "1x1"),
+        _v5e(4, "2x2"),
+        _v5e(8, "2x4"),
+        _v5e(16, "4x4"),
+        _v5e(32, "4x8"),
+        _v5e(64, "8x8"),
+        _v5e(128, "8x16"),
+        _v5e(256, "16x16"),
+        _v5p(4, "2x2x1"),
+        _v5p(8, "2x2x2"),
+        _v5p(16, "2x2x4"),
+        _v5p(32, "2x4x4"),
+        _v5p(64, "4x4x4"),
+        _v5p(128, "4x4x8"),
+        _v6e(1, "1x1"),
+        _v6e(4, "2x2"),
+        _v6e(8, "2x4"),
+        _v6e(16, "4x4"),
+        _v6e(32, "4x8"),
+        _v6e(64, "8x8"),
+        _v6e(256, "16x16"),
+    ]
+}
+
+
+def slice_shape(name: str) -> SliceShape:
+    """Look up a slice shape by canonical name, e.g. ``v5e-16``.
+
+    Unknown names are synthesized as single-host custom shapes so that
+    user-supplied accelerator entries outside the catalog still work.
+    """
+    if name in TPU_SLICE_CATALOG:
+        return TPU_SLICE_CATALOG[name]
+    if "-" in name:
+        gen, _, tail = name.partition("-")
+        try:
+            chips = int(tail)
+        except ValueError:
+            chips = 1
+        return SliceShape(name, gen, f"1x{chips}", chips)
+    return SliceShape(name, name, "1x1", 1)

@@ -1,0 +1,408 @@
+"""Serializable system specification.
+
+Capability parity with the reference's spec structs
+(/root/reference/pkg/config/types.go:11-155), re-expressed for TPU:
+
+* an "accelerator" is a TPU *slice shape* (v5e-4, v5p-8, ...) whose cost is
+  chips × per-chip $/hr, instead of a GPU card bundle with a multiplicity;
+* capacity is counted in *chips per generation pool* with whole-host
+  granularity, instead of cards per GPU type;
+* everything is a plain dataclass with `to_dict`/`from_dict` for round-trip
+  through ConfigMaps/JSON — no Kubernetes types leak in here.
+
+This module is pure data: no I/O, no JAX, importable anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+from inferno_tpu.config.defaults import SaturationPolicy
+from inferno_tpu.config.tpu_catalog import SliceShape, slice_shape
+
+
+def _get(d: Mapping[str, Any], *names: str, default: Any = None) -> Any:
+    for n in names:
+        if n in d:
+            return d[n]
+    return default
+
+
+@dataclasses.dataclass
+class AcceleratorSpec:
+    """One allocatable TPU slice shape.
+
+    TPU analogue of the reference's AcceleratorSpec
+    (pkg/config/types.go:29-37): `name` is the slice shape, `pool` is the
+    capacity pool (generation), `chips` replaces multiplicity, and `cost`
+    is derived from per-chip pricing.
+    """
+
+    name: str  # slice shape name, e.g. "v5e-16"
+    pool: str = ""  # capacity pool / generation; default from name
+    chips: int = 0  # chips per slice; default from catalog
+    mem_per_chip_gb: float = 16.0  # HBM per chip
+    mem_bw_gbs: float = 820.0  # HBM bandwidth per chip
+    cost_per_chip_hr: float = 0.0  # cents per chip-hour
+
+    def __post_init__(self) -> None:
+        shape = slice_shape(self.name)
+        if not self.pool:
+            self.pool = shape.generation
+        if not self.chips:
+            self.chips = shape.chips
+
+    @property
+    def shape(self) -> SliceShape:
+        return slice_shape(self.name)
+
+    @property
+    def cost(self) -> float:
+        """Cost of one slice of this shape, cents/hr."""
+        return self.cost_per_chip_hr * self.chips
+
+    @property
+    def mem_gb(self) -> float:
+        return self.mem_per_chip_gb * self.chips
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "pool": self.pool,
+            "chips": self.chips,
+            "memPerChipGB": self.mem_per_chip_gb,
+            "memBWGBs": self.mem_bw_gbs,
+            "costPerChipHr": self.cost_per_chip_hr,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "AcceleratorSpec":
+        return cls(
+            name=d["name"],
+            pool=_get(d, "pool", "type", default=""),
+            chips=int(_get(d, "chips", "multiplicity", default=0) or 0),
+            mem_per_chip_gb=float(_get(d, "memPerChipGB", "memSize", default=16.0)),
+            mem_bw_gbs=float(_get(d, "memBWGBs", "memBW", default=820.0)),
+            cost_per_chip_hr=float(_get(d, "costPerChipHr", "cost", default=0.0)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeParms:
+    """decode time(batch) = alpha + beta * batch (msec)
+    (reference: pkg/config/types.go:74-78)."""
+
+    alpha: float = 0.0
+    beta: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefillParms:
+    """prefill time(batch) = gamma + delta * inputTokens * batch (msec)
+    (reference: pkg/config/types.go:80-84)."""
+
+    gamma: float = 0.0
+    delta: float = 0.0
+
+
+@dataclasses.dataclass
+class ModelPerfSpec:
+    """Performance profile of one model on one slice shape
+    (reference: pkg/config/types.go:63-72).
+
+    `slices_per_replica` is the TPU analogue of accCount: the number of
+    slice units one replica of the model occupies (normally 1 — the slice
+    shape itself encodes the parallelism footprint).
+    """
+
+    name: str  # model id
+    acc: str  # slice shape name
+    slices_per_replica: int = 1
+    max_batch_size: int = 0
+    at_tokens: int = 0  # avg tokens/request assumed for max_batch_size
+    decode_parms: DecodeParms = dataclasses.field(default_factory=DecodeParms)
+    prefill_parms: PrefillParms = dataclasses.field(default_factory=PrefillParms)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "acc": self.acc,
+            "slicesPerReplica": self.slices_per_replica,
+            "maxBatchSize": self.max_batch_size,
+            "atTokens": self.at_tokens,
+            "decodeParms": {"alpha": self.decode_parms.alpha, "beta": self.decode_parms.beta},
+            "prefillParms": {"gamma": self.prefill_parms.gamma, "delta": self.prefill_parms.delta},
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ModelPerfSpec":
+        dp = _get(d, "decodeParms", default={}) or {}
+        pp = _get(d, "prefillParms", default={}) or {}
+        return cls(
+            name=d["name"],
+            acc=d["acc"],
+            slices_per_replica=int(_get(d, "slicesPerReplica", "accCount", default=1) or 1),
+            max_batch_size=int(_get(d, "maxBatchSize", default=0) or 0),
+            at_tokens=int(_get(d, "atTokens", default=0) or 0),
+            decode_parms=DecodeParms(float(dp.get("alpha", 0.0)), float(dp.get("beta", 0.0))),
+            prefill_parms=PrefillParms(float(pp.get("gamma", 0.0)), float(pp.get("delta", 0.0))),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelTarget:
+    """SLO targets for one model within a service class
+    (reference: pkg/config/types.go:99-104)."""
+
+    model: str
+    slo_itl: float = 0.0  # inter-token latency, msec (0 = no target)
+    slo_ttft: float = 0.0  # time to first token incl. queueing, msec
+    slo_tps: float = 0.0  # token throughput, tokens/sec
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "model": self.model,
+            "slo-itl": self.slo_itl,
+            "slo-ttft": self.slo_ttft,
+            "slo-tps": self.slo_tps,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ModelTarget":
+        return cls(
+            model=d["model"],
+            slo_itl=float(_get(d, "slo-itl", "slo-tpot", "sloItl", default=0.0) or 0.0),
+            slo_ttft=float(_get(d, "slo-ttft", "sloTtft", default=0.0) or 0.0),
+            slo_tps=float(_get(d, "slo-tps", "sloTps", default=0.0) or 0.0),
+        )
+
+
+@dataclasses.dataclass
+class ServiceClassSpec:
+    """A service class: priority plus per-model SLO targets
+    (reference: pkg/config/types.go:92-96)."""
+
+    name: str
+    priority: int  # [1,100], lower value = higher priority
+    model_targets: list[ModelTarget] = dataclasses.field(default_factory=list)
+
+    def target_for(self, model: str) -> ModelTarget | None:
+        for t in self.model_targets:
+            if t.model == model:
+                return t
+        return None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "priority": self.priority,
+            "modelTargets": [t.to_dict() for t in self.model_targets],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ServiceClassSpec":
+        return cls(
+            name=d["name"],
+            priority=int(d.get("priority", 100)),
+            model_targets=[ModelTarget.from_dict(t) for t in _get(d, "modelTargets", "data", default=[]) or []],
+        )
+
+
+@dataclasses.dataclass
+class ServerLoadSpec:
+    """Observed load statistics for a server
+    (reference: pkg/config/types.go:135-139)."""
+
+    arrival_rate: float = 0.0  # requests/min
+    avg_in_tokens: int = 0
+    avg_out_tokens: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "arrivalRate": self.arrival_rate,
+            "avgInTokens": self.avg_in_tokens,
+            "avgOutTokens": self.avg_out_tokens,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ServerLoadSpec":
+        return cls(
+            arrival_rate=float(d.get("arrivalRate", 0.0) or 0.0),
+            avg_in_tokens=int(d.get("avgInTokens", 0) or 0),
+            avg_out_tokens=int(d.get("avgOutTokens", 0) or 0),
+        )
+
+
+@dataclasses.dataclass
+class AllocationData:
+    """A (possibly current, possibly desired) allocation of a slice shape to
+    a server (reference: pkg/config/types.go:124-132)."""
+
+    accelerator: str = ""  # slice shape name; "" = none
+    num_replicas: int = 0  # pod-slices
+    max_batch: int = 0
+    cost: float = 0.0  # cents/hr
+    itl_average: float = 0.0  # msec
+    ttft_average: float = 0.0  # msec
+    load: ServerLoadSpec = dataclasses.field(default_factory=ServerLoadSpec)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "accelerator": self.accelerator,
+            "numReplicas": self.num_replicas,
+            "maxBatch": self.max_batch,
+            "cost": self.cost,
+            "itlAverage": self.itl_average,
+            "ttftAverage": self.ttft_average,
+            "load": self.load.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "AllocationData":
+        return cls(
+            accelerator=d.get("accelerator", "") or "",
+            num_replicas=int(d.get("numReplicas", 0) or 0),
+            max_batch=int(d.get("maxBatch", 0) or 0),
+            cost=float(d.get("cost", 0.0) or 0.0),
+            itl_average=float(d.get("itlAverage", 0.0) or 0.0),
+            ttft_average=float(d.get("ttftAverage", 0.0) or 0.0),
+            load=ServerLoadSpec.from_dict(d.get("load", {}) or {}),
+        )
+
+
+@dataclasses.dataclass
+class ServerSpec:
+    """One managed inference server variant
+    (reference: pkg/config/types.go:112-121)."""
+
+    name: str
+    class_name: str = ""
+    model: str = ""
+    keep_accelerator: bool = False
+    min_num_replicas: int = 0
+    max_batch_size: int = 0  # overrides profile-derived batch if > 0
+    current_alloc: AllocationData = dataclasses.field(default_factory=AllocationData)
+    desired_alloc: AllocationData = dataclasses.field(default_factory=AllocationData)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "class": self.class_name,
+            "model": self.model,
+            "keepAccelerator": self.keep_accelerator,
+            "minNumReplicas": self.min_num_replicas,
+            "maxBatchSize": self.max_batch_size,
+            "currentAlloc": self.current_alloc.to_dict(),
+            "desiredAlloc": self.desired_alloc.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ServerSpec":
+        return cls(
+            name=d["name"],
+            class_name=_get(d, "class", "className", default="") or "",
+            model=d.get("model", "") or "",
+            keep_accelerator=bool(d.get("keepAccelerator", False)),
+            min_num_replicas=int(d.get("minNumReplicas", 0) or 0),
+            max_batch_size=int(d.get("maxBatchSize", 0) or 0),
+            current_alloc=AllocationData.from_dict(d.get("currentAlloc", {}) or {}),
+            desired_alloc=AllocationData.from_dict(d.get("desiredAlloc", {}) or {}),
+        )
+
+
+@dataclasses.dataclass
+class OptimizerSpec:
+    """Optimizer behavior switches (reference: pkg/config/types.go:151-155)."""
+
+    unlimited: bool = True  # unlimited chip capacity (cloud / planning mode)
+    delayed_best_effort: bool = False
+    saturation_policy: str = SaturationPolicy.NONE.value
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "unlimited": self.unlimited,
+            "delayedBestEffort": self.delayed_best_effort,
+            "saturationPolicy": self.saturation_policy,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "OptimizerSpec":
+        return cls(
+            unlimited=bool(d.get("unlimited", True)),
+            delayed_best_effort=bool(d.get("delayedBestEffort", False)),
+            saturation_policy=str(d.get("saturationPolicy", SaturationPolicy.NONE.value)),
+        )
+
+
+@dataclasses.dataclass
+class CapacitySpec:
+    """Available chips per pool (generation), e.g. {"v5e": 64, "v5p": 32}.
+
+    TPU analogue of the reference's per-type card counts
+    (pkg/config/types.go:48-56): the unit here is a *chip*, and allocations
+    consume chips in whole-slice (hence whole-host) quanta.
+    """
+
+    chips: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"chips": dict(self.chips)}
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "CapacitySpec":
+        if "chips" in d:
+            return cls(chips={k: int(v) for k, v in d["chips"].items()})
+        # reference shape: {"count": [{"type": ..., "count": ...}]}
+        counts = d.get("count", []) or []
+        return cls(chips={c["type"]: int(c["count"]) for c in counts})
+
+
+@dataclasses.dataclass
+class SystemSpec:
+    """Everything the optimizer needs for one cycle
+    (reference: pkg/config/types.go:11-21)."""
+
+    accelerators: list[AcceleratorSpec] = dataclasses.field(default_factory=list)
+    models: list[ModelPerfSpec] = dataclasses.field(default_factory=list)
+    service_classes: list[ServiceClassSpec] = dataclasses.field(default_factory=list)
+    servers: list[ServerSpec] = dataclasses.field(default_factory=list)
+    optimizer: OptimizerSpec = dataclasses.field(default_factory=OptimizerSpec)
+    capacity: CapacitySpec = dataclasses.field(default_factory=CapacitySpec)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "acceleratorData": {"accelerators": [a.to_dict() for a in self.accelerators]},
+            "modelData": {"models": [m.to_dict() for m in self.models]},
+            "serviceClassData": {"serviceClasses": [s.to_dict() for s in self.service_classes]},
+            "serverData": {"servers": [s.to_dict() for s in self.servers]},
+            "optimizerData": {"optimizer": self.optimizer.to_dict()},
+            "capacityData": self.capacity.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "SystemSpec":
+        if "system" in d:
+            d = d["system"]
+        return cls(
+            accelerators=[
+                AcceleratorSpec.from_dict(a)
+                for a in (d.get("acceleratorData", {}) or {}).get("accelerators", []) or []
+            ],
+            models=[
+                ModelPerfSpec.from_dict(m)
+                for m in (d.get("modelData", {}) or {}).get("models", []) or []
+            ],
+            service_classes=[
+                ServiceClassSpec.from_dict(s)
+                for s in (d.get("serviceClassData", {}) or {}).get("serviceClasses", []) or []
+            ],
+            servers=[
+                ServerSpec.from_dict(s)
+                for s in (d.get("serverData", {}) or {}).get("servers", []) or []
+            ],
+            optimizer=OptimizerSpec.from_dict(
+                (d.get("optimizerData", {}) or {}).get("optimizer", {}) or {}
+            ),
+            capacity=CapacitySpec.from_dict(d.get("capacityData", {}) or {}),
+        )
