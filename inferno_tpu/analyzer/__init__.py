@@ -3,6 +3,7 @@ from inferno_tpu.analyzer.queue import (
     AnalyzerError,
     QueueAnalyzer,
     QueueStats,
+    RequestSize,
     TargetPerf,
     TargetRate,
     build_analyzer,
@@ -17,6 +18,7 @@ __all__ = [
     "AnalyzerError",
     "QueueAnalyzer",
     "QueueStats",
+    "RequestSize",
     "TargetPerf",
     "TargetRate",
     "build_analyzer",

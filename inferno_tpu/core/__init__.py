@@ -1,0 +1,29 @@
+from inferno_tpu.core.allocation import (
+    Allocation,
+    AllocationDiff,
+    allocation_diff,
+    allocation_from_data,
+    create_allocation,
+    transition_penalty,
+)
+from inferno_tpu.core.system import (
+    Accelerator,
+    Model,
+    Server,
+    ServiceClass,
+    System,
+)
+
+__all__ = [
+    "Allocation",
+    "AllocationDiff",
+    "allocation_diff",
+    "allocation_from_data",
+    "create_allocation",
+    "transition_penalty",
+    "Accelerator",
+    "Model",
+    "Server",
+    "ServiceClass",
+    "System",
+]

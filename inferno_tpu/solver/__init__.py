@@ -1,0 +1,5 @@
+from inferno_tpu.solver.greedy import solve_greedy
+from inferno_tpu.solver.solver import Solver, solve_unlimited
+from inferno_tpu.solver.optimizer import Optimizer, optimize
+
+__all__ = ["Solver", "solve_unlimited", "solve_greedy", "Optimizer", "optimize"]

@@ -1,0 +1,268 @@
+"""Greedy allocation under limited chip capacity.
+
+Capability parity with /root/reference/pkg/solver/greedy.go:35-341, with
+TPU capacity arithmetic: availability is counted in **chips per pool**
+(generation), and one replica consumes
+`slices_per_replica × slice.chips` chips — whole-host quanta by
+construction of the slice catalog.
+
+Algorithm (unchanged from the reference, which is sound and well-tested
+there): each server sorts its candidate allocations by value; servers are
+processed in (priority, regret-to-next-best desc, value desc) order; when
+a server's current candidate doesn't fit the remaining chips it advances
+to its next candidate and is re-inserted by binary search; servers left
+without any feasible candidate get best-effort treatment per the
+saturation policy.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import math
+from typing import TYPE_CHECKING
+
+from inferno_tpu.config.defaults import SaturationPolicy
+from inferno_tpu.config.types import OptimizerSpec
+from inferno_tpu.core.allocation import Allocation
+
+if TYPE_CHECKING:
+    from inferno_tpu.core.system import System
+
+
+@dataclasses.dataclass
+class _ServerEntry:
+    """(reference serverEntry: pkg/solver/greedy.go:16-22)"""
+
+    server_name: str
+    priority: int
+    cur_index: int
+    allocations: list[Allocation]
+    delta: float  # regret: value gap to the next-best allocation
+
+    def sort_key(self) -> tuple:
+        # priority asc, then delta desc, then current value desc
+        # (reference orderFunc: pkg/solver/greedy.go:76-85)
+        return (self.priority, -self.delta, -self.allocations[self.cur_index].value)
+
+
+def _chips_per_replica(system: "System", server_name: str, alloc: Allocation) -> tuple[str, int] | None:
+    """Pool name and chips consumed per replica of this allocation
+    (reference unitsPerReplica: pkg/solver/greedy.go:139-140)."""
+    server = system.servers.get(server_name)
+    if server is None:
+        return None
+    model = system.models.get(server.model_name)
+    acc = system.accelerators.get(alloc.accelerator)
+    if model is None or acc is None:
+        return None
+    return acc.pool, model.slices_per_replica(acc.name) * acc.chips
+
+
+def solve_greedy(system: "System", optimizer_spec: OptimizerSpec) -> None:
+    """(reference SolveGreedy: pkg/solver/greedy.go:35-104)"""
+    available = dict(system.capacity)
+
+    entries: list[_ServerEntry] = []
+    for server_name, server in system.servers.items():
+        server.remove_allocation()
+        if not server.all_allocations:
+            continue
+        allocs = sorted(server.all_allocations.values(), key=lambda a: a.value)
+        delta = allocs[1].value - allocs[0].value if len(allocs) > 1 else math.inf
+        entries.append(
+            _ServerEntry(
+                server_name=server_name,
+                priority=server.priority(system),
+                cur_index=0,
+                allocations=allocs,
+                delta=delta,
+            )
+        )
+    entries.sort(key=_ServerEntry.sort_key)
+
+    if optimizer_spec.delayed_best_effort:
+        unallocated = _allocate(system, entries, available)
+        _best_effort(system, unallocated, available, optimizer_spec.saturation_policy)
+    else:
+        for group in _make_priority_groups(entries):
+            unallocated = _allocate(system, group, available)
+            _best_effort(system, unallocated, available, optimizer_spec.saturation_policy)
+
+
+def _allocate(
+    system: "System", entries: list[_ServerEntry], available: dict[str, int]
+) -> list[_ServerEntry]:
+    """Greedy SLO-satisfying pass; returns entries that got nothing
+    (reference allocate: pkg/solver/greedy.go:107-166)."""
+    entries = list(entries)
+    keys = [e.sort_key() for e in entries]
+    unallocated: list[_ServerEntry] = []
+
+    while entries:
+        top = entries.pop(0)
+        keys.pop(0)
+        if not top.allocations:
+            continue
+        server = system.servers.get(top.server_name)
+        if server is None:
+            continue
+        alloc = top.allocations[top.cur_index]
+        pool_chips = _chips_per_replica(system, top.server_name, alloc)
+        if pool_chips is None:
+            continue
+        pool, per_replica = pool_chips
+        need = alloc.num_replicas * per_replica
+
+        if available.get(pool, 0) >= need:
+            available[pool] = available.get(pool, 0) - need
+            server.set_allocation(alloc)
+        else:
+            top.cur_index += 1
+            if top.cur_index + 1 < len(top.allocations):
+                top.delta = (
+                    top.allocations[top.cur_index + 1].value
+                    - top.allocations[top.cur_index].value
+                )
+            elif top.cur_index == len(top.allocations):
+                unallocated.append(top)
+                continue
+            else:
+                top.delta = math.inf
+            key = top.sort_key()
+            i = bisect.bisect_left(keys, key)
+            entries.insert(i, top)
+            keys.insert(i, key)
+    return unallocated
+
+
+def _best_effort(
+    system: "System",
+    unallocated: list[_ServerEntry],
+    available: dict[str, int],
+    policy: str,
+) -> None:
+    """(reference bestEffort: pkg/solver/greedy.go:169-190)
+
+    Unknown policy strings behave as NONE (the reference's switch falls
+    through silently); a typo in a ConfigMap must not abort the cycle.
+    """
+    try:
+        pol = SaturationPolicy(policy) if policy else SaturationPolicy.NONE
+    except ValueError:
+        pol = SaturationPolicy.NONE
+    if pol is SaturationPolicy.PRIORITY_EXHAUSTIVE:
+        _allocate_maximally(system, unallocated, available)
+    elif pol is SaturationPolicy.PRIORITY_ROUND_ROBIN:
+        for group in _make_priority_groups(unallocated):
+            _allocate_equally(system, group, available)
+    elif pol is SaturationPolicy.ROUND_ROBIN:
+        _allocate_equally(system, unallocated, available)
+    # SaturationPolicy.NONE: leave unallocated
+
+
+def _scaled(alloc: Allocation, num_replicas: int) -> Allocation:
+    """Clone with replica count reduced to what fits, cost/value scaled
+    proportionally (reference: pkg/solver/greedy.go:206-211, 305-310)."""
+    factor = num_replicas / alloc.num_replicas
+    out = alloc.clone()
+    out.cost *= factor
+    out.value *= factor
+    out.num_replicas = num_replicas
+    return out
+
+
+def _allocate_maximally(
+    system: "System", entries: list[_ServerEntry], available: dict[str, int]
+) -> None:
+    """Exhaustive best-effort in priority order
+    (reference allocateMaximally: pkg/solver/greedy.go:194-223)."""
+    for entry in entries:
+        server = system.servers.get(entry.server_name)
+        if server is None:
+            continue
+        for alloc in entry.allocations:
+            pool_chips = _chips_per_replica(system, entry.server_name, alloc)
+            if pool_chips is None:
+                continue
+            pool, per_replica = pool_chips
+            if per_replica <= 0:
+                continue
+            max_replicas = min(available.get(pool, 0) // per_replica, alloc.num_replicas)
+            if max_replicas > 0:
+                server.set_allocation(_scaled(alloc, max_replicas))
+                available[pool] = available.get(pool, 0) - max_replicas * per_replica
+                break
+
+
+@dataclasses.dataclass
+class _Ticket:
+    """(reference serverAllocationTicket: pkg/solver/greedy.go:225-235)"""
+
+    entry: _ServerEntry
+    active: bool = False
+    pool: str = ""
+    per_replica: int = 0
+    num_replicas: int = 0
+    final_alloc: Allocation | None = None
+
+
+def _allocate_equally(
+    system: "System", entries: list[_ServerEntry], available: dict[str, int]
+) -> None:
+    """Round-robin one replica at a time within the group
+    (reference allocateEqually: pkg/solver/greedy.go:239-316)."""
+    tickets: dict[str, _Ticket] = {}
+    for entry in entries:
+        if entry.server_name in system.servers:
+            tickets[entry.server_name] = _Ticket(entry=entry)
+
+    allocated: dict[str, _Ticket] = {}
+    while tickets:
+        for entry in entries:
+            name = entry.server_name
+            ticket = tickets.get(name)
+            if ticket is None:
+                continue
+            if not ticket.active:
+                for alloc in entry.allocations:
+                    pool_chips = _chips_per_replica(system, name, alloc)
+                    if pool_chips is None:
+                        continue
+                    pool, per_replica = pool_chips
+                    if per_replica > 0 and available.get(pool, 0) >= per_replica:
+                        ticket.active = True
+                        ticket.pool = pool
+                        ticket.per_replica = per_replica
+                        ticket.final_alloc = alloc
+                        break
+                if not ticket.active:
+                    del tickets[name]
+                    continue
+            assert ticket.final_alloc is not None
+            replicas_available = available.get(ticket.pool, 0) // ticket.per_replica
+            if min(replicas_available, ticket.final_alloc.num_replicas) > 0 and (
+                ticket.num_replicas < ticket.final_alloc.num_replicas
+            ):
+                ticket.num_replicas += 1
+                available[ticket.pool] = available.get(ticket.pool, 0) - ticket.per_replica
+                allocated[name] = ticket
+            else:
+                del tickets[name]
+
+    for name, ticket in allocated.items():
+        assert ticket.final_alloc is not None
+        server = system.servers[name]
+        server.set_allocation(_scaled(ticket.final_alloc, ticket.num_replicas))
+
+
+def _make_priority_groups(entries: list[_ServerEntry]) -> list[list[_ServerEntry]]:
+    """Partition (already sorted) entries into equal-priority groups
+    (reference makePriorityGroups: pkg/solver/greedy.go:321-341)."""
+    groups: list[list[_ServerEntry]] = []
+    for entry in entries:
+        if groups and groups[-1][0].priority == entry.priority:
+            groups[-1].append(entry)
+        else:
+            groups.append([entry])
+    return groups

@@ -1,0 +1,63 @@
+"""Optimizer: the one-call entry point for an optimization cycle.
+
+Combines the reference's optimizer wrapper (wall-clock measurement,
+/root/reference/pkg/solver/optimizer.go:24-48) and manager
+(/root/reference/pkg/manager/manager.go:13-27) — without the manager's
+singleton assignment: callers pass the `System` in and get a solution out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from inferno_tpu.config.types import AllocationData, OptimizerSpec
+from inferno_tpu.core.allocation import AllocationDiff
+from inferno_tpu.core.system import PoolUsage, System
+from inferno_tpu.solver.solver import Solver
+
+
+@dataclasses.dataclass
+class OptimizationResult:
+    solution: dict[str, AllocationData]
+    diffs: dict[str, AllocationDiff]
+    pool_usage: dict[str, PoolUsage]
+    solution_time_msec: float  # solver wall-clock (the BASELINE metric)
+    analysis_time_msec: float  # candidate-sizing wall-clock
+
+
+class Optimizer:
+    """(reference: pkg/solver/optimizer.go:13-48)"""
+
+    def __init__(self, spec: OptimizerSpec | None = None):
+        self.spec = spec or OptimizerSpec()
+        self.solver = Solver(self.spec)
+        self.solution_time_msec = 0.0
+
+    def optimize(self, system: System, calculate: bool = True) -> OptimizationResult:
+        """Run (optionally) candidate sizing and the assignment solve.
+
+        With calculate=True this performs the full cycle: per-server
+        candidate allocations over all slice shapes (the analyzer hot
+        loop), then the assignment solve, per-pool chip accounting, and
+        solution extraction.
+        """
+        t0 = time.perf_counter()
+        if calculate:
+            system.calculate_all()
+        t1 = time.perf_counter()
+        self.solver.solve(system)
+        self.solution_time_msec = (time.perf_counter() - t1) * 1000.0
+        usage = system.allocate_by_pool()
+        return OptimizationResult(
+            solution=system.generate_solution(),
+            diffs=self.solver.diff_allocation,
+            pool_usage=usage,
+            solution_time_msec=self.solution_time_msec,
+            analysis_time_msec=(t1 - t0) * 1000.0,
+        )
+
+
+def optimize(system: System, spec: OptimizerSpec | None = None) -> OptimizationResult:
+    """Convenience one-shot optimization."""
+    return Optimizer(spec).optimize(system)

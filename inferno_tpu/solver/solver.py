@@ -1,0 +1,55 @@
+"""Allocation assignment solver.
+
+Capability parity with /root/reference/pkg/solver/solver.go:13-93: snapshot
+current allocations, dispatch to unlimited or greedy mode, compute
+per-server orchestration diffs. Takes the `System` explicitly (no
+singletons).
+"""
+
+from __future__ import annotations
+
+from inferno_tpu.config.types import OptimizerSpec
+from inferno_tpu.core.allocation import Allocation, AllocationDiff, allocation_diff
+from inferno_tpu.core.system import System
+from inferno_tpu.solver.greedy import solve_greedy
+
+
+def solve_unlimited(system: System) -> None:
+    """Unlimited chip capacity: each server independently takes its
+    minimum-value (cheapest after transition penalty) candidate
+    (reference SolveUnlimited: pkg/solver/solver.go:63-79)."""
+    for server in system.servers.values():
+        server.remove_allocation()
+        best: Allocation | None = None
+        for alloc in server.all_allocations.values():
+            if best is None or alloc.value < best.value:
+                best = alloc
+        if best is not None:
+            server.set_allocation(best)
+
+
+class Solver:
+    """(reference: pkg/solver/solver.go:13-59)"""
+
+    def __init__(self, optimizer_spec: OptimizerSpec):
+        self.optimizer_spec = optimizer_spec
+        self.current_allocation: dict[str, Allocation] = {}
+        self.diff_allocation: dict[str, AllocationDiff] = {}
+
+    def solve(self, system: System) -> None:
+        # cur_allocation is always a value (an empty accelerator means "no
+        # allocation"); allocation_diff normalizes that to "none"
+        self.current_allocation = {
+            name: server.cur_allocation for name, server in system.servers.items()
+        }
+
+        if self.optimizer_spec.unlimited:
+            solve_unlimited(system)
+        else:
+            solve_greedy(system, self.optimizer_spec)
+
+        self.diff_allocation = {}
+        for name, server in system.servers.items():
+            diff = allocation_diff(self.current_allocation.get(name), server.allocation)
+            if diff is not None:
+                self.diff_allocation[name] = diff

@@ -1,0 +1,125 @@
+"""Shared test fixtures.
+
+TPU translation of the reference's unit-test fixtures
+(/root/reference/test/utils/unitutils.go:64-135): two service classes
+(Premium prio 1: itl 24 / ttft 500; Freemium prio 10: itl 200 / ttft 2000)
+and a heterogeneous pool, with slice-shape accelerators instead of GPU
+types.
+"""
+
+from inferno_tpu.config import (
+    AcceleratorSpec,
+    AllocationData,
+    CapacitySpec,
+    DecodeParms,
+    ModelPerfSpec,
+    ModelTarget,
+    OptimizerSpec,
+    PrefillParms,
+    ServerLoadSpec,
+    ServerSpec,
+    ServiceClassSpec,
+    SystemSpec,
+)
+
+LLAMA8B = "meta-llama/Llama-3.1-8B"
+LLAMA70B = "meta-llama/Llama-3.1-70B"
+
+
+def make_accelerators() -> list[AcceleratorSpec]:
+    return [
+        # slice cost: 4 chips * 10 = 40 c/hr (A100-cost analogue)
+        AcceleratorSpec(name="v5e-4", cost_per_chip_hr=10.0),
+        # slice cost: 8 chips * 16.25 = 130 c/hr
+        AcceleratorSpec(name="v5p-8", cost_per_chip_hr=16.25),
+        # slice cost: 16 chips * 10 = 160 c/hr
+        AcceleratorSpec(name="v5e-16", cost_per_chip_hr=10.0),
+    ]
+
+
+def make_perf(model: str = LLAMA8B) -> list[ModelPerfSpec]:
+    return [
+        ModelPerfSpec(
+            name=model,
+            acc="v5e-4",
+            slices_per_replica=1,
+            max_batch_size=64,
+            at_tokens=128,
+            decode_parms=DecodeParms(alpha=18.0, beta=0.3),
+            prefill_parms=PrefillParms(gamma=5.0, delta=0.02),
+        ),
+        ModelPerfSpec(
+            name=model,
+            acc="v5p-8",
+            slices_per_replica=1,
+            max_batch_size=96,
+            at_tokens=128,
+            decode_parms=DecodeParms(alpha=10.0, beta=0.2),
+            prefill_parms=PrefillParms(gamma=3.0, delta=0.01),
+        ),
+        ModelPerfSpec(
+            name=model,
+            acc="v5e-16",
+            slices_per_replica=1,
+            max_batch_size=128,
+            at_tokens=128,
+            decode_parms=DecodeParms(alpha=12.0, beta=0.25),
+            prefill_parms=PrefillParms(gamma=4.0, delta=0.012),
+        ),
+    ]
+
+
+def make_service_classes(model: str = LLAMA8B) -> list[ServiceClassSpec]:
+    return [
+        ServiceClassSpec(
+            name="Premium",
+            priority=1,
+            model_targets=[ModelTarget(model=model, slo_itl=24.0, slo_ttft=500.0)],
+        ),
+        ServiceClassSpec(
+            name="Freemium",
+            priority=10,
+            model_targets=[ModelTarget(model=model, slo_itl=200.0, slo_ttft=2000.0)],
+        ),
+    ]
+
+
+def make_server(
+    name: str = "default/llama-premium",
+    class_name: str = "Premium",
+    model: str = LLAMA8B,
+    arrival_rate: float = 120.0,  # req/min
+    in_tokens: int = 128,
+    out_tokens: int = 128,
+    min_replicas: int = 1,
+    current: AllocationData | None = None,
+) -> ServerSpec:
+    cur = current or AllocationData()
+    cur.load = ServerLoadSpec(
+        arrival_rate=arrival_rate, avg_in_tokens=in_tokens, avg_out_tokens=out_tokens
+    )
+    return ServerSpec(
+        name=name,
+        class_name=class_name,
+        model=model,
+        min_num_replicas=min_replicas,
+        current_alloc=cur,
+    )
+
+
+def make_system_spec(
+    servers: list[ServerSpec] | None = None,
+    unlimited: bool = True,
+    capacity: dict[str, int] | None = None,
+    saturation_policy: str = "None",
+) -> SystemSpec:
+    return SystemSpec(
+        accelerators=make_accelerators(),
+        models=make_perf(),
+        service_classes=make_service_classes(),
+        servers=servers if servers is not None else [make_server()],
+        optimizer=OptimizerSpec(
+            unlimited=unlimited, saturation_policy=saturation_policy
+        ),
+        capacity=CapacitySpec(chips=capacity or {}),
+    )

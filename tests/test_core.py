@@ -1,0 +1,167 @@
+"""Core domain tests: system construction, allocation sizing, penalties.
+
+Mirrors the strategy of the reference's core tests
+(/root/reference/pkg/core/{allocation,system,server}_test.go): hand-built
+SystemSpec fixtures, no Kubernetes.
+"""
+
+import math
+
+import pytest
+
+from inferno_tpu.config import AllocationData, ServerLoadSpec
+from inferno_tpu.core import (
+    System,
+    allocation_diff,
+    create_allocation,
+    transition_penalty,
+)
+from inferno_tpu.core.allocation import Allocation
+
+from fixtures import LLAMA8B, make_server, make_system_spec
+
+
+def test_system_from_spec():
+    system = System(make_system_spec())
+    assert set(system.accelerators) == {"v5e-4", "v5p-8", "v5e-16"}
+    assert LLAMA8B in system.models
+    assert set(system.service_classes) == {"Premium", "Freemium"}
+    assert len(system.servers) == 1
+    # slice economics: v5e-4 = 4 chips at 10 c/chip-hr
+    assert system.accelerators["v5e-4"].cost == pytest.approx(40.0)
+    assert system.accelerators["v5e-4"].pool == "v5e"
+    assert system.accelerators["v5p-8"].chips == 8
+
+
+def test_spec_round_trip():
+    spec = make_system_spec()
+    from inferno_tpu.config import SystemSpec
+
+    spec2 = SystemSpec.from_dict(spec.to_dict())
+    assert spec2.to_dict() == spec.to_dict()
+
+
+def test_create_allocation_sizes_replicas():
+    spec = make_system_spec()
+    system = System(spec)
+    name = spec.servers[0].name
+    alloc = create_allocation(system, name, "v5e-4")
+    assert alloc is not None
+    assert alloc.accelerator == "v5e-4"
+    assert alloc.num_replicas >= 1
+    # cost = replicas * slices * chips * chip-cost
+    assert alloc.cost == pytest.approx(alloc.num_replicas * 1 * 4 * 10.0)
+    assert alloc.itl <= 24.0 * 1.01
+    assert alloc.ttft <= 500.0 * 1.01
+    assert 0.0 <= alloc.rho <= 1.0
+    assert alloc.max_rpm > 0
+    # replicas = ceil(total_rate / rate_star)
+    total_rate = 120.0 / 60.0
+    rate_star = alloc.max_arrv_rate_per_replica * 1000.0
+    assert alloc.num_replicas == math.ceil(total_rate / rate_star)
+
+
+def test_create_allocation_scales_with_load():
+    low = make_system_spec([make_server(arrival_rate=60.0)])
+    high = make_system_spec([make_server(arrival_rate=6000.0)])
+    a_low = create_allocation(System(low), low.servers[0].name, "v5e-4")
+    a_high = create_allocation(System(high), high.servers[0].name, "v5e-4")
+    assert a_high.num_replicas > a_low.num_replicas
+
+
+def test_create_allocation_zero_load_holds_min_replicas():
+    spec = make_system_spec([make_server(arrival_rate=0.0, min_replicas=2)])
+    system = System(spec)
+    alloc = create_allocation(system, spec.servers[0].name, "v5e-4")
+    assert alloc.num_replicas == 2
+    assert alloc.cost == pytest.approx(2 * 4 * 10.0)
+    assert alloc.rho == 0.0
+
+
+def test_create_allocation_scale_to_zero():
+    spec = make_system_spec([make_server(arrival_rate=0.0, min_replicas=0)])
+    system = System(spec)
+    alloc = create_allocation(system, spec.servers[0].name, "v5e-4")
+    assert alloc.accelerator == ""
+    assert alloc.num_replicas == 0
+    assert alloc.cost == 0.0
+
+
+def test_create_allocation_unknown_entities():
+    spec = make_system_spec()
+    system = System(spec)
+    assert create_allocation(system, "nope", "v5e-4") is None
+    assert create_allocation(system, spec.servers[0].name, "h100") is None
+
+
+def test_create_allocation_missing_target():
+    spec = make_system_spec([make_server(class_name="Premium", model="unknown-model")])
+    system = System(spec)
+    assert create_allocation(system, spec.servers[0].name, "v5e-4") is None
+
+
+def test_transition_penalty_semantics():
+    a = Allocation(accelerator="v5e-4", num_replicas=2, batch_size=8, cost=80.0)
+    same = Allocation(accelerator="v5e-4", num_replicas=2, batch_size=8, cost=80.0)
+    scaled = Allocation(accelerator="v5e-4", num_replicas=3, batch_size=8, cost=120.0)
+    moved = Allocation(accelerator="v5p-8", num_replicas=1, batch_size=8, cost=130.0)
+    assert transition_penalty(a, same) == 0.0
+    assert transition_penalty(a, scaled) == pytest.approx(40.0)
+    # slice-shape change: 0.1*(80+130) + (130-80)
+    assert transition_penalty(a, moved) == pytest.approx(21.0 + 50.0)
+
+
+def test_server_calculate_values_are_penalties():
+    # fresh server (empty current alloc): value = 1.1 * cost for every shape
+    spec = make_system_spec()
+    system = System(spec)
+    server = system.servers[spec.servers[0].name]
+    server.calculate(system)
+    assert len(server.all_allocations) == 3
+    for alloc in server.all_allocations.values():
+        assert alloc.value == pytest.approx(1.1 * alloc.cost, rel=1e-6)
+
+
+def test_server_keep_accelerator_pins_candidates():
+    current = AllocationData(accelerator="v5p-8", num_replicas=1, cost=130.0)
+    srv = make_server(current=current)
+    srv.keep_accelerator = True
+    spec = make_system_spec([srv])
+    system = System(spec)
+    server = system.servers[srv.name]
+    server.calculate(system)
+    assert set(server.all_allocations) == {"v5p-8"}
+
+
+def test_allocation_diff():
+    a = Allocation(accelerator="v5e-4", num_replicas=2, batch_size=8, cost=80.0)
+    b = Allocation(accelerator="v5e-16", num_replicas=1, batch_size=8, cost=160.0)
+    d = allocation_diff(a, b)
+    assert d.cost_diff == pytest.approx(80.0)
+    assert allocation_diff(None, None) is None
+    d2 = allocation_diff(None, b)
+    assert d2.old_accelerator == "none"
+
+
+def test_saturated():
+    a = Allocation(
+        accelerator="v5e-4",
+        num_replicas=2,
+        batch_size=8,
+        cost=80.0,
+        max_arrv_rate_per_replica=0.001,  # req/msec -> 60 req/min per replica
+    )
+    assert a.max_rpm == pytest.approx(60.0)
+    assert not a.saturated(100.0)
+    assert a.saturated(121.0)
+
+
+def test_pool_usage_accounting():
+    spec = make_system_spec()
+    system = System(spec)
+    server = system.servers[spec.servers[0].name]
+    server.calculate(system)
+    server.set_allocation(server.all_allocations["v5e-16"])
+    usage = system.allocate_by_pool()
+    assert usage["v5e"].chips == server.allocation.num_replicas * 16
+    assert usage["v5e"].cost == pytest.approx(server.allocation.cost)
