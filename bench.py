@@ -1,0 +1,151 @@
+"""Benchmark: full optimization-cycle wall-clock for a production-scale fleet.
+
+The reference's per-cycle cost is dominated by candidate sizing — a
+sequential per-(server, accelerator) loop of ~200 bisection solves of a
+K-state birth-death chain (SURVEY.md §3.3; reference measures it as
+SolutionTimeMsec, /root/reference/pkg/solver/optimizer.go:30-37, no
+published number). Our baseline is that exact algorithm (scalar float64
+path, same semantics); the measured value is the TPU-batched fleet path
+(inferno_tpu.ops.queueing) doing the same sizing for all lanes in one jitted
+program, plus the assignment solve.
+
+Prints ONE JSON line:
+  metric      fleet_sizing_cycle_ms — wall-clock of a full optimization
+              cycle (candidate sizing + solver) for a 64-variant,
+              8-slice-shape fleet (512 lanes)
+  value       median cycle time of the TPU path (steady state; the
+              controller reuses the compiled program across cycles)
+  vs_baseline speedup over the reference-algorithm sequential path run
+              on this host (baseline_ms / value_ms; >1 = faster)
+"""
+
+import json
+import statistics
+import time
+
+import numpy as np
+
+from inferno_tpu.config import (
+    AcceleratorSpec,
+    AllocationData,
+    DecodeParms,
+    ModelPerfSpec,
+    ModelTarget,
+    OptimizerSpec,
+    PrefillParms,
+    ServerLoadSpec,
+    ServerSpec,
+    ServiceClassSpec,
+    SystemSpec,
+)
+from inferno_tpu.core import System
+from inferno_tpu.parallel import calculate_fleet
+from inferno_tpu.solver import optimize
+
+N_VARIANTS = 64
+SHAPES = [
+    ("v5e-1", 1.2), ("v5e-4", 1.2), ("v5e-8", 1.2), ("v5e-16", 1.2),
+    ("v5p-4", 4.2), ("v5p-8", 4.2), ("v6e-4", 2.7), ("v6e-8", 2.7),
+]
+MODELS = ["llama-3.1-8b", "llama-3.1-70b", "mixtral-8x7b", "gemma-2-27b"]
+
+
+def build_spec(seed: int = 0) -> SystemSpec:
+    rng = np.random.default_rng(seed)
+    accelerators = [
+        AcceleratorSpec(name=name, cost_per_chip_hr=cost) for name, cost in SHAPES
+    ]
+    perfs = []
+    for model_i, model in enumerate(MODELS):
+        size_factor = [1.0, 5.0, 3.0, 2.2][model_i]
+        for name, _ in SHAPES:
+            chips = AcceleratorSpec(name=name).chips
+            speed = chips ** 0.6
+            perfs.append(
+                ModelPerfSpec(
+                    name=model, acc=name,
+                    max_batch_size=max(8, int(16 * chips / size_factor)),
+                    at_tokens=128,
+                    decode_parms=DecodeParms(
+                        alpha=4.0 * size_factor / speed + 2.0,
+                        beta=0.3 * size_factor / speed,
+                    ),
+                    prefill_parms=PrefillParms(
+                        gamma=2.0 * size_factor / speed + 1.0,
+                        delta=0.02 * size_factor / speed,
+                    ),
+                )
+            )
+    classes = [
+        ServiceClassSpec(
+            name="Premium", priority=1,
+            model_targets=[ModelTarget(model=m, slo_itl=40.0, slo_ttft=800.0) for m in MODELS],
+        ),
+        ServiceClassSpec(
+            name="Freemium", priority=10,
+            model_targets=[ModelTarget(model=m, slo_itl=200.0, slo_ttft=3000.0) for m in MODELS],
+        ),
+    ]
+    servers = []
+    for i in range(N_VARIANTS):
+        servers.append(
+            ServerSpec(
+                name=f"ns{i % 8}/variant-{i}",
+                class_name="Premium" if i % 3 else "Freemium",
+                model=MODELS[i % len(MODELS)],
+                min_num_replicas=1,
+                current_alloc=AllocationData(
+                    load=ServerLoadSpec(
+                        arrival_rate=float(rng.integers(60, 6000)),
+                        avg_in_tokens=int(rng.integers(64, 2048)),
+                        avg_out_tokens=int(rng.integers(32, 512)),
+                    )
+                ),
+            )
+        )
+    return SystemSpec(
+        accelerators=accelerators, models=perfs, service_classes=classes,
+        servers=servers, optimizer=OptimizerSpec(unlimited=True),
+    )
+
+
+def time_cycle(fn, repeats: int = 5) -> float:
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append((time.perf_counter() - t0) * 1000.0)
+    return statistics.median(times)
+
+
+def main() -> None:
+    spec = build_spec()
+
+    def scalar_cycle():
+        system = System(build_spec())
+        system.calculate_all()
+        optimize(system, spec.optimizer)
+
+    def fleet_cycle():
+        system = System(build_spec())
+        calculate_fleet(system)
+        optimize(system, spec.optimizer)
+
+    fleet_cycle()  # warmup: jit compile (cached across cycles in production)
+    baseline_ms = time_cycle(scalar_cycle, repeats=3)
+    value_ms = time_cycle(fleet_cycle, repeats=7)
+
+    print(
+        json.dumps(
+            {
+                "metric": "fleet_sizing_cycle_ms",
+                "value": round(value_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(baseline_ms / value_ms, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+from inferno_tpu.models.linear import FittedProfile, fit_profile
+from inferno_tpu.models.surrogate import (
+    SurrogateConfig,
+    init_surrogate,
+    surrogate_forward,
+    surrogate_param_specs,
+)
+
+__all__ = [
+    "FittedProfile",
+    "fit_profile",
+    "SurrogateConfig",
+    "init_surrogate",
+    "surrogate_forward",
+    "surrogate_param_specs",
+]

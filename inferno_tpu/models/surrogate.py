@@ -1,0 +1,145 @@
+"""Learned performance surrogate.
+
+The linear alpha/beta/gamma/delta profile is a two-parameter-per-stage
+approximation; real TPU serving latency bends with batch, context length
+and slice shape (quantization effects at host boundaries, KV-cache HBM
+pressure). The surrogate is a small transformer regressor that predicts
+(ITL, TTFT, throughput) for a (slice shape, model, load) feature vector,
+trained continuously on telemetry; the optimizer can consult it where the
+linear profile's residuals are large.
+
+Implemented in pure JAX (explicit parameter pytree) so the tensor-
+parallel partition specs are visible and exact:
+
+* feature scalars are embedded as a short token sequence -> attention
+  heads and MLP hidden shard over the "tp" mesh axis;
+* batch shards over "dp";
+* the design scales the same way the big-model training stacks do — this
+  is the framework's demonstration of dp x tp SPMD over a Mesh (the
+  control plane itself needs no giant model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+# feature vector layout (see featurize()):
+N_FEATURES = 10
+N_OUTPUTS = 3  # itl_ms, ttft_ms, throughput_rps (log-space)
+
+
+@dataclasses.dataclass(frozen=True)
+class SurrogateConfig:
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    n_tokens: int = N_FEATURES  # one token per feature
+
+
+def featurize(
+    chips: np.ndarray,
+    cost_per_chip: np.ndarray,
+    alpha: np.ndarray,
+    beta: np.ndarray,
+    gamma: np.ndarray,
+    delta: np.ndarray,
+    batch: np.ndarray,
+    in_tokens: np.ndarray,
+    out_tokens: np.ndarray,
+    rate: np.ndarray,
+) -> np.ndarray:
+    """Stack raw quantities into the [B, N_FEATURES] input (log1p scaled)."""
+    cols = [chips, cost_per_chip, alpha, beta, gamma, delta, batch, in_tokens, out_tokens, rate]
+    x = np.stack([np.asarray(c, dtype=np.float32) for c in cols], axis=-1)
+    return np.log1p(np.abs(x)) * np.sign(x)
+
+
+def init_surrogate(key: jax.Array, cfg: SurrogateConfig = SurrogateConfig()) -> dict:
+    """Parameter pytree; names match surrogate_param_specs."""
+    k = iter(jax.random.split(key, 4 + 8 * cfg.n_layers))
+    d, h, f, t = cfg.d_model, cfg.n_heads, cfg.d_ff, cfg.n_tokens
+    scale = lambda fan_in: 1.0 / np.sqrt(fan_in)
+
+    params: dict = {
+        "embed": jax.random.normal(next(k), (t, d)) * 0.02,
+        "pos": jax.random.normal(next(k), (t, d)) * 0.02,
+        "head_w": jax.random.normal(next(k), (d, N_OUTPUTS)) * scale(d),
+        "head_b": jnp.zeros((N_OUTPUTS,)),
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append(
+            {
+                "qkv_w": jax.random.normal(next(k), (d, 3, h, d // h)) * scale(d),
+                "attn_out_w": jax.random.normal(next(k), (h, d // h, d)) * scale(d),
+                "ln1_scale": jnp.ones((d,)),
+                "ln1_bias": jnp.zeros((d,)),
+                "mlp_in_w": jax.random.normal(next(k), (d, f)) * scale(d),
+                "mlp_in_b": jnp.zeros((f,)),
+                "mlp_out_w": jax.random.normal(next(k), (f, d)) * scale(f),
+                "mlp_out_b": jnp.zeros((d,)),
+                "ln2_scale": jnp.ones((d,)),
+                "ln2_bias": jnp.zeros((d,)),
+            }
+        )
+    return params
+
+
+def surrogate_param_specs(cfg: SurrogateConfig = SurrogateConfig()) -> dict:
+    """PartitionSpecs for tensor parallelism over mesh axis "tp":
+    attention heads and MLP hidden dim are sharded; everything else is
+    replicated. Mirrors the Megatron-style column/row split."""
+    layer = {
+        "qkv_w": P(None, None, "tp", None),  # column-parallel over heads
+        "attn_out_w": P("tp", None, None),  # row-parallel back to d_model
+        "ln1_scale": P(None),
+        "ln1_bias": P(None),
+        "mlp_in_w": P(None, "tp"),  # column-parallel
+        "mlp_in_b": P("tp"),
+        "mlp_out_w": P("tp", None),  # row-parallel
+        "mlp_out_b": P(None),
+        "ln2_scale": P(None),
+        "ln2_bias": P(None),
+    }
+    return {
+        "embed": P(None, None),
+        "pos": P(None, None),
+        "head_w": P(None, None),
+        "head_b": P(None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + 1e-6) * scale + bias
+
+
+def surrogate_forward(params: dict, x: jax.Array, cfg: SurrogateConfig = SurrogateConfig()) -> jax.Array:
+    """x: [B, N_FEATURES] -> [B, N_OUTPUTS].
+
+    Each feature scalar scales its learned token embedding; two pre-LN
+    transformer blocks; mean-pool; linear head.
+    """
+    tok = params["embed"][None, :, :] * x[:, :, None] + params["pos"][None, :, :]
+    h = tok  # [B, T, D]
+    for layer in params["layers"]:
+        y = _layer_norm(h, layer["ln1_scale"], layer["ln1_bias"])
+        qkv = jnp.einsum("btd,dchk->cbthk", y, layer["qkv_w"])  # [3,B,T,H,K]
+        q, k_, v = qkv[0], qkv[1], qkv[2]
+        logits = jnp.einsum("bthk,bshk->bhts", q, k_) / np.sqrt(q.shape[-1])
+        attn = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("bhts,bshk->bthk", attn, v)
+        h = h + jnp.einsum("bthk,hkd->btd", ctx, layer["attn_out_w"])
+        y = _layer_norm(h, layer["ln2_scale"], layer["ln2_bias"])
+        ff = jax.nn.gelu(y @ layer["mlp_in_w"] + layer["mlp_in_b"])
+        h = h + ff @ layer["mlp_out_w"] + layer["mlp_out_b"]
+    pooled = jnp.mean(h, axis=1)  # [B, D]
+    return pooled @ params["head_w"] + params["head_b"]

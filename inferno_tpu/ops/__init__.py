@@ -1,0 +1,15 @@
+from inferno_tpu.ops.queueing import (
+    FleetParams,
+    FleetResult,
+    fleet_analyze,
+    fleet_size,
+    make_fleet_size_fn,
+)
+
+__all__ = [
+    "FleetParams",
+    "FleetResult",
+    "fleet_analyze",
+    "fleet_size",
+    "make_fleet_size_fn",
+]

@@ -1,0 +1,308 @@
+"""Batched queueing analysis and SLO sizing on TPU.
+
+The reference sizes each (server, accelerator) pair sequentially: ~100
+bisection iterations × 2 targets, each solving a K-state birth-death
+chain with a scalar loop (/root/reference/pkg/core/allocation.go:27-163,
+pkg/analyzer/mm1modelstatedependent.go:70-116). Here the whole fleet is
+one jitted program:
+
+* every pair is a lane of a [P]-shaped batch;
+* the stationary distribution is log-space: since
+  log p[k] = k·log(lam) − Σ_{j≤k} log mu(j), the service-rate cumsum is
+  **independent of the arrival rate** and is hoisted out of the search —
+  each bisection iteration is one fused multiply-add over the [P, K]
+  grid plus masked reductions (logsumexp), no recursion, no rescaling;
+* bisection runs as a fixed-iteration `lax.fori_loop` whose body solves
+  *all* lanes at once, so the search cost amortizes over the fleet;
+* everything is static-shaped: per-lane batch sizes and occupancy caps
+  are masks over a shared padded K. Callers bucket lanes by occupancy
+  (inferno_tpu.parallel.fleet) so small lanes don't pay for large grids.
+
+Scalar semantics are defined by `inferno_tpu.analyzer.queue`; tests check this
+module against it lane by lane.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from inferno_tpu.config.defaults import STABILITY_SAFETY_FRACTION
+
+# match the scalar analyzer (inferno_tpu/analyzer/queue.py RATE_EPSILON)
+_RATE_EPSILON = 1e-3
+
+DEFAULT_BISECT_ITERS = 32  # f32 interval resolution saturates ~30 halvings
+
+
+class FleetParams(NamedTuple):
+    """Structure-of-arrays description of all (server, slice-shape) pairs.
+
+    All float arrays are f32[P]; int arrays i32[P]. Rates are req/sec,
+    times msec (analyzer conventions).
+    """
+
+    alpha: jax.Array  # decode base, msec
+    beta: jax.Array  # decode slope, msec/req
+    gamma: jax.Array  # prefill base, msec
+    delta: jax.Array  # prefill slope, msec/(token*req)
+    in_tokens: jax.Array  # avg input tokens
+    out_tokens: jax.Array  # avg output tokens (>= 1)
+    max_batch: jax.Array  # per-lane max batch size N
+    occupancy_cap: jax.Array  # K = N + max queue
+    target_ttft: jax.Array  # msec; 0 disables
+    target_itl: jax.Array  # msec; 0 disables
+    target_tps: jax.Array  # tokens/sec; 0 disables
+    total_rate: jax.Array  # offered load, req/sec
+    min_replicas: jax.Array  # i32
+    cost_per_replica: jax.Array  # cents/hr (chips x chip cost x slices)
+
+
+class FleetResult(NamedTuple):
+    feasible: jax.Array  # bool[P]: SLOs achievable on this pair
+    lambda_star: jax.Array  # binding rate, req/msec
+    rate_star: jax.Array  # max throughput per replica at SLO, req/sec
+    num_replicas: jax.Array  # i32[P]
+    cost: jax.Array  # cents/hr
+    itl: jax.Array  # expected per-replica ITL at operating point, msec
+    ttft: jax.Array  # expected per-replica TTFT, msec
+    rho: jax.Array  # expected utilization
+
+
+class _Grid(NamedTuple):
+    """Rate-independent precomputation shared by every solve."""
+
+    cml: jax.Array  # [P, K] cumsum of log mu(k); +inf beyond the cap
+    kk: jax.Array  # [1, K+1] state indices as f32
+    le_n: jax.Array  # [P, K+1] mask: state k <= max_batch
+    cap_idx: jax.Array  # [P, 1] occupancy cap (blocking state index)
+    nmax: jax.Array  # [P] max_batch as f32
+
+
+def _num_decodes(p: FleetParams) -> jax.Array:
+    # out_tokens - 1, except the decode-only single-token case which still
+    # pays one decode (analyzer.queue.service_rates)
+    nd = p.out_tokens - 1.0
+    return jnp.where((p.in_tokens == 0) & (p.out_tokens == 1), 1.0, nd)
+
+
+def _service_rate(p: FleetParams, n: jax.Array) -> jax.Array:
+    """mu(n) in req/msec; `n` broadcasts against the lane axis."""
+    prefill = jnp.where(p.in_tokens > 0, p.gamma + p.delta * p.in_tokens * n, 0.0)
+    decode = _num_decodes(p) * (p.alpha + p.beta * n)
+    return n / (prefill + decode)
+
+
+def _make_grid(p: FleetParams, k_max: int) -> _Grid:
+    k = jnp.arange(1, k_max + 1, dtype=jnp.float32)[None, :]  # [1, K]
+    nmax = p.max_batch.astype(jnp.float32)
+    n_eff = jnp.minimum(k, nmax[:, None])
+    prefill = jnp.where(
+        p.in_tokens[:, None] > 0,
+        p.gamma[:, None] + p.delta[:, None] * p.in_tokens[:, None] * n_eff,
+        0.0,
+    )
+    decode = _num_decodes(p)[:, None] * (p.alpha[:, None] + p.beta[:, None] * n_eff)
+    log_mu = jnp.log(n_eff) - jnp.log(prefill + decode)
+    valid = k <= p.occupancy_cap.astype(jnp.float32)[:, None]
+    log_mu = jnp.where(valid, log_mu, jnp.inf)  # +inf => p[k] = 0 beyond cap
+    kk = jnp.arange(0, k_max + 1, dtype=jnp.float32)[None, :]
+    return _Grid(
+        cml=jnp.cumsum(log_mu, axis=1),
+        kk=kk,
+        le_n=kk <= nmax[:, None],
+        cap_idx=p.occupancy_cap[:, None],
+        nmax=nmax,
+    )
+
+
+def _solve_stats(lam: jax.Array, grid: _Grid):
+    """Stationary statistics at arrival rates `lam` (req/msec) for all
+    lanes: (wait, serv, in_servers, throughput)."""
+    log_lam = jnp.log(lam)[:, None]
+    body = grid.kk[:, 1:] * log_lam - grid.cml  # [P, K]
+    logp = jnp.concatenate([jnp.zeros_like(lam)[:, None], body], axis=1)  # [P, K+1]
+    logz = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
+    prob = jnp.exp(logp - logz)
+
+    in_system = jnp.sum(grid.kk * prob, axis=1)
+    mass_le_n = jnp.sum(jnp.where(grid.le_n, prob, 0.0), axis=1)
+    in_servers = jnp.sum(jnp.where(grid.le_n, grid.kk * prob, 0.0), axis=1) + (
+        grid.nmax * (1.0 - mass_le_n)
+    )
+    p_block = jnp.take_along_axis(prob, grid.cap_idx, axis=1)[:, 0]
+    throughput = lam * (1.0 - p_block)
+    resp = in_system / throughput
+    serv = in_servers / throughput
+    wait = jnp.maximum(resp - serv, 0.0)
+    return wait, serv, in_servers, throughput
+
+
+def _concurrency(p: FleetParams, serv: jax.Array) -> jax.Array:
+    """Effective concurrency from avg service time
+    (analyzer.queue.effective_concurrency)."""
+    tokens = p.out_tokens - 1.0
+    numer = serv - (p.gamma + p.alpha * tokens)
+    denom = p.delta * p.in_tokens + p.beta * tokens
+    nmax = p.max_batch.astype(jnp.float32)
+    safe = jnp.clip(numer / jnp.where(denom > 0, denom, 1.0), 0.0, nmax)
+    return jnp.where(denom > 0, safe, jnp.where(numer > 0, nmax, 0.0))
+
+
+def _ttft_itl_at(lam: jax.Array, p: FleetParams, grid: _Grid):
+    wait, serv, _, _ = _solve_stats(lam, grid)
+    conc = _concurrency(p, serv)
+    prefill = jnp.where(p.in_tokens > 0, p.gamma + p.delta * p.in_tokens * conc, 0.0)
+    return wait + prefill, p.alpha + p.beta * conc
+
+
+def _bisect_increasing(
+    p: FleetParams,
+    grid: _Grid,
+    lam_min: jax.Array,
+    lam_max: jax.Array,
+    target: jax.Array,
+    y_lo: jax.Array,
+    y_hi: jax.Array,
+    which: int,  # 0: ttft, 1: itl
+    n_iters: int,
+):
+    """Vectorized bisection for an increasing metric-of-rate.
+
+    Returns (lam_star, feasible): lanes whose target is below the value at
+    lam_min are infeasible; targets above the value at lam_max clamp to
+    lam_max (the reference's -1/+1 indicator semantics,
+    pkg/analyzer/utils.go:44-50).
+    """
+    feasible = target >= y_lo * (1.0 - 1e-6)
+    clamp_hi = target >= y_hi
+
+    def body(_, state):
+        lo, hi = state
+        mid = 0.5 * (lo + hi)
+        y = _ttft_itl_at(mid, p, grid)[which]
+        too_high = y > target
+        return jnp.where(too_high, lo, mid), jnp.where(too_high, mid, hi)
+
+    lo, hi = jax.lax.fori_loop(0, n_iters, body, (lam_min, lam_max))
+    lam = 0.5 * (lo + hi)
+    lam = jnp.where(clamp_hi, lam_max, lam)
+    lam = jnp.where(feasible, lam, lam_min)
+    return lam, feasible
+
+
+def fleet_analyze(lam: jax.Array, params: FleetParams, k_max: int):
+    """Per-replica operating point at arrival rates `lam` (req/msec):
+    (ttft, itl, rho, throughput req/msec)."""
+    grid = _make_grid(params, k_max)
+    wait, serv, in_servers, tput = _solve_stats(lam, grid)
+    conc = _concurrency(params, serv)
+    prefill = jnp.where(
+        params.in_tokens > 0, params.gamma + params.delta * params.in_tokens * conc, 0.0
+    )
+    itl = params.alpha + params.beta * conc
+    rho = jnp.clip(in_servers / grid.nmax, 0.0, 1.0)
+    return wait + prefill, itl, rho, tput
+
+
+def fleet_size(
+    params: FleetParams, k_max: int, n_iters: int = DEFAULT_BISECT_ITERS
+) -> FleetResult:
+    """Size every lane: max per-replica rate meeting TTFT/ITL/TPS targets,
+    replica count for the offered load, cost, and the expected per-replica
+    operating point. The batched equivalent of
+    QueueAnalyzer.size + create_allocation's arithmetic
+    (reference: pkg/analyzer/queueanalyzer.go:185-255 +
+    pkg/core/allocation.go:126-157)."""
+    grid = _make_grid(params, k_max)
+    one = jnp.ones_like(params.alpha)
+    mu_1 = _service_rate(params, one)
+    mu_n = _service_rate(params, grid.nmax)
+    lam_min = mu_1 * _RATE_EPSILON
+    lam_max = mu_n * (1.0 - _RATE_EPSILON)
+
+    # metric values at both rate bounds, one solve per bound
+    ttft_lo, itl_lo = _ttft_itl_at(lam_min, params, grid)
+    ttft_hi, itl_hi = _ttft_itl_at(lam_max, params, grid)
+
+    lam_ttft, ok_ttft = _bisect_increasing(
+        params, grid, lam_min, lam_max, params.target_ttft, ttft_lo, ttft_hi, 0, n_iters
+    )
+    lam_itl, ok_itl = _bisect_increasing(
+        params, grid, lam_min, lam_max, params.target_itl, itl_lo, itl_hi, 1, n_iters
+    )
+    lam_ttft = jnp.where(params.target_ttft > 0, lam_ttft, lam_max)
+    ok_ttft = jnp.where(params.target_ttft > 0, ok_ttft, True)
+    lam_itl = jnp.where(params.target_itl > 0, lam_itl, lam_max)
+    ok_itl = jnp.where(params.target_itl > 0, ok_itl, True)
+    lam_tps = jnp.where(
+        params.target_tps > 0, lam_max * (1.0 - STABILITY_SAFETY_FRACTION), lam_max
+    )
+
+    lam_star = jnp.minimum(jnp.minimum(lam_ttft, lam_itl), lam_tps)
+    feasible = ok_ttft & ok_itl
+
+    # throughput at the binding rate -> per-replica capacity (req/sec)
+    tput_star = _solve_stats(lam_star, grid)[3]
+    rate_star = tput_star * 1000.0
+
+    # replicas for the offered load; TPS targets replace the offered rate
+    # (reference: pkg/core/allocation.go:133-141)
+    total = jnp.where(
+        params.target_tps > 0, params.target_tps / params.out_tokens, params.total_rate
+    )
+    replicas = jnp.ceil(total / rate_star).astype(jnp.int32)
+    replicas = jnp.maximum(replicas, params.min_replicas)
+    replicas = jnp.maximum(replicas, 1)
+    cost = replicas.astype(jnp.float32) * params.cost_per_replica
+
+    # expected per-replica operating point
+    per_replica_rate = total / replicas.astype(jnp.float32) / 1000.0  # req/msec
+    per_replica_rate = jnp.maximum(per_replica_rate, lam_min)
+    wait, serv, in_servers, _ = _solve_stats(per_replica_rate, grid)
+    conc = _concurrency(params, serv)
+    prefill = jnp.where(
+        params.in_tokens > 0, params.gamma + params.delta * params.in_tokens * conc, 0.0
+    )
+
+    return FleetResult(
+        feasible=feasible,
+        lambda_star=lam_star,
+        rate_star=rate_star,
+        num_replicas=replicas,
+        cost=cost,
+        itl=params.alpha + params.beta * conc,
+        ttft=wait + prefill,
+        rho=jnp.clip(in_servers / grid.nmax, 0.0, 1.0),
+    )
+
+
+def make_fleet_size_fn(k_max: int, n_iters: int = DEFAULT_BISECT_ITERS):
+    """Jitted fleet sizing specialized to a padded occupancy grid `k_max`."""
+    return jax.jit(lambda params: fleet_size(params, k_max, n_iters))
+
+
+def pack_result(res: FleetResult) -> jax.Array:
+    """Pack a FleetResult into one f32[8, P] array (single D2H transfer)."""
+    return jnp.stack([f.astype(jnp.float32) for f in res])
+
+
+def unpack_result(arr) -> FleetResult:
+    """Inverse of pack_result (host side, numpy)."""
+    return FleetResult(
+        feasible=arr[0] > 0.5,
+        lambda_star=arr[1],
+        rate_star=arr[2],
+        num_replicas=arr[3].astype("int32"),
+        cost=arr[4],
+        itl=arr[5],
+        ttft=arr[6],
+        rho=arr[7],
+    )
+
+
+def make_fleet_size_packed_fn(k_max: int, n_iters: int = DEFAULT_BISECT_ITERS):
+    """Jitted fleet sizing returning the packed [8, P] result."""
+    return jax.jit(lambda params: pack_result(fleet_size(params, k_max, n_iters)))

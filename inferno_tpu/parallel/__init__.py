@@ -1,0 +1,11 @@
+from inferno_tpu.parallel.fleet import FleetPlan, build_fleet, calculate_fleet, solve_fleet
+from inferno_tpu.parallel.mesh import fleet_mesh, shard_fleet_params
+
+__all__ = [
+    "FleetPlan",
+    "build_fleet",
+    "calculate_fleet",
+    "solve_fleet",
+    "fleet_mesh",
+    "shard_fleet_params",
+]

@@ -1,0 +1,33 @@
+"""Device-mesh utilities.
+
+The fleet solve is embarrassingly parallel over lanes, so its natural
+sharding is 1-D data parallelism over a `jax.sharding.Mesh`; XLA handles
+the rest. Multi-host meshes work the same way (jax.make_mesh over all
+addressable devices), with collectives riding ICI within a slice.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+FLEET_AXIS = "fleet"
+
+
+def fleet_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over (up to) all local devices, axis name "fleet"."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (FLEET_AXIS,))
+
+
+def shard_fleet_params(params, mesh: Mesh):
+    """Place a FleetParams pytree with the lane axis sharded over the mesh.
+
+    Lane counts must be padded to a multiple of the mesh size (the fleet
+    builder pads with dummy lanes).
+    """
+    sharding = NamedSharding(mesh, P(FLEET_AXIS))
+    return jax.device_put(params, sharding)

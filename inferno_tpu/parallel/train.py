@@ -1,0 +1,125 @@
+"""Sharded training of the performance surrogate.
+
+SPMD recipe: pick a (dp, tp) mesh, commit parameters with Megatron-style
+partition specs (heads/MLP-hidden over "tp"), shard the batch over "dp",
+and jit the whole step — XLA inserts the gradient all-reduce over dp and
+the activation collectives over tp. No hand-written collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from inferno_tpu.models.surrogate import (
+    SurrogateConfig,
+    init_surrogate,
+    surrogate_forward,
+    surrogate_param_specs,
+)
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+
+
+def train_mesh(n_devices: int | None = None, tp: int = 2) -> Mesh:
+    """(dp, tp) mesh over local devices; tp divides the device count."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    tp = min(tp, n)
+    while n % tp:
+        tp -= 1
+    arr = np.array(devices).reshape(n // tp, tp)
+    return Mesh(arr, (DP_AXIS, TP_AXIS))
+
+
+def _param_shardings(mesh: Mesh, cfg: SurrogateConfig):
+    specs = surrogate_param_specs(cfg)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: dict
+    opt_state: optax.OptState
+    step_fn: Callable
+    mesh: Mesh
+    cfg: SurrogateConfig
+
+
+def init_train_state(
+    key: jax.Array,
+    mesh: Mesh,
+    cfg: SurrogateConfig = SurrogateConfig(),
+    learning_rate: float = 3e-4,
+) -> TrainState:
+    optimizer = optax.adamw(learning_rate)
+    params = init_surrogate(key, cfg)
+    params = jax.device_put(params, _param_shardings(mesh, cfg))
+    # init under jit so moment buffers inherit the parameter shardings
+    opt_state = jax.jit(optimizer.init)(params)
+
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            pred = surrogate_forward(p, x, cfg)
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return TrainState(
+        params=params, opt_state=opt_state, step_fn=jax.jit(step), mesh=mesh, cfg=cfg
+    )
+
+
+def shard_batch(state: TrainState, x: np.ndarray, y: np.ndarray):
+    sh = NamedSharding(state.mesh, P(DP_AXIS, None))
+    return jax.device_put(jnp.asarray(x), sh), jax.device_put(jnp.asarray(y), sh)
+
+
+def train_step(state: TrainState, x, y) -> float:
+    """One full (forward+backward+update) step; returns the loss."""
+    state.params, state.opt_state, loss = state.step_fn(
+        state.params, state.opt_state, x, y
+    )
+    return float(loss)
+
+
+def fit_surrogate(
+    x: np.ndarray,
+    y: np.ndarray,
+    mesh: Mesh | None = None,
+    cfg: SurrogateConfig = SurrogateConfig(),
+    epochs: int = 100,
+    batch_size: int = 256,
+    learning_rate: float = 1e-3,
+    seed: int = 0,
+) -> tuple[TrainState, list[float]]:
+    """Fit the surrogate to telemetry (features x [N,F], targets y [N,3])."""
+    if mesh is None:
+        mesh = train_mesh()
+    state = init_train_state(jax.random.key(seed), mesh, cfg, learning_rate)
+    n = x.shape[0]
+    dp = mesh.shape[DP_AXIS]
+    batch_size = max(dp, (min(batch_size, n) // dp) * dp)
+    rng = np.random.default_rng(seed)
+    losses = []
+    for _ in range(epochs):
+        idx = rng.choice(n, size=batch_size, replace=n < batch_size)
+        bx, by = shard_batch(state, x[idx], y[idx])
+        losses.append(train_step(state, bx, by))
+    return state, losses

@@ -34,17 +34,29 @@ class Optimizer:
         self.solver = Solver(self.spec)
         self.solution_time_msec = 0.0
 
-    def optimize(self, system: System, calculate: bool = True) -> OptimizationResult:
+    def optimize(
+        self, system: System, calculate: bool | None = None
+    ) -> OptimizationResult:
         """Run (optionally) candidate sizing and the assignment solve.
 
-        With calculate=True this performs the full cycle: per-server
-        candidate allocations over all slice shapes (the analyzer hot
-        loop), then the assignment solve, per-pool chip accounting, and
-        solution extraction.
+        calculate=None (default) sizes candidates only if no server has
+        any yet — so a system prepared by `calculate_fleet` (the TPU
+        path) is not silently re-sized by the scalar path. True forces a
+        re-size; False skips it.
         """
         t0 = time.perf_counter()
         if calculate:
             system.calculate_all()
+        elif calculate is None:
+            # auto: size any server that has no candidates yet, so a system
+            # prepared by calculate_fleet (the TPU path) is not re-sized by
+            # the scalar path, while servers added afterwards still get
+            # candidates. A System is a per-cycle value (the controller
+            # rebuilds it each reconcile, like the reference); mutating
+            # loads between optimize() calls requires calculate=True.
+            for server in system.servers.values():
+                if not server.all_allocations:
+                    server.calculate(system)
         t1 = time.perf_counter()
         self.solver.solve(system)
         self.solution_time_msec = (time.perf_counter() - t1) * 1000.0

@@ -2,13 +2,22 @@
 
 Tests run on CPU with 8 virtual XLA devices so that multi-chip sharding
 paths (jax.sharding.Mesh over dp/tp axes) are exercised without TPU
-hardware. Must run before the first `import jax` anywhere in the test
-process.
+hardware.
+
+Note: this environment preloads jax in every Python process (site hook)
+with JAX_PLATFORMS=axon, so plain env vars are too late; backends are
+initialized lazily, so overriding via jax.config before first device use
+still works.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

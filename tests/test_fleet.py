@@ -1,0 +1,128 @@
+"""Parity tests: batched TPU fleet sizing vs the scalar analyzer path.
+
+The scalar path (float64, exact reference semantics) is ground truth; the
+f32 batched path must agree on feasibility and replica counts, and agree
+closely on rates/latencies.
+"""
+
+import numpy as np
+import pytest
+
+from inferno_tpu.core import System
+from inferno_tpu.parallel import build_fleet, calculate_fleet, fleet_mesh
+
+from fixtures import make_server, make_system_spec
+
+
+def _scalar_system(spec):
+    system = System(spec)
+    system.calculate_all()
+    return system
+
+
+def _fleet_system(spec, **kw):
+    system = System(spec)
+    calculate_fleet(system, **kw)
+    return system
+
+
+def _spec_multi():
+    servers = [
+        make_server(name="ns/premium", class_name="Premium", arrival_rate=600.0),
+        make_server(name="ns/freemium", class_name="Freemium", arrival_rate=2400.0,
+                    in_tokens=256, out_tokens=64),
+        make_server(name="ns/light", class_name="Premium", arrival_rate=30.0),
+    ]
+    return make_system_spec(servers)
+
+
+def test_fleet_matches_scalar_candidates():
+    spec = _spec_multi()
+    scalar = _scalar_system(spec)
+    fleet = _fleet_system(spec)
+    for name, s_server in scalar.servers.items():
+        f_server = fleet.servers[name]
+        assert set(f_server.all_allocations) == set(s_server.all_allocations), name
+        for acc, s_alloc in s_server.all_allocations.items():
+            f_alloc = f_server.all_allocations[acc]
+            assert f_alloc.batch_size == s_alloc.batch_size
+            assert abs(f_alloc.num_replicas - s_alloc.num_replicas) <= 1
+            assert f_alloc.max_arrv_rate_per_replica == pytest.approx(
+                s_alloc.max_arrv_rate_per_replica, rel=2e-2
+            )
+            assert f_alloc.itl == pytest.approx(s_alloc.itl, rel=5e-2, abs=0.5)
+            assert f_alloc.ttft == pytest.approx(s_alloc.ttft, rel=5e-2, abs=2.0)
+            assert f_alloc.rho == pytest.approx(s_alloc.rho, rel=5e-2, abs=0.02)
+            # value is the transition penalty (fresh server: 1.1 * cost)
+            assert f_alloc.value == pytest.approx(1.1 * f_alloc.cost, rel=1e-5)
+
+
+def test_fleet_zero_load_parity():
+    spec = make_system_spec([make_server(arrival_rate=0.0, min_replicas=2)])
+    scalar = _scalar_system(spec)
+    fleet = _fleet_system(spec)
+    name = spec.servers[0].name
+    s = scalar.servers[name].all_allocations
+    f = fleet.servers[name].all_allocations
+    assert set(f) == set(s)
+    for acc in s:
+        assert f[acc].num_replicas == s[acc].num_replicas == 2
+        assert f[acc].cost == pytest.approx(s[acc].cost)
+
+
+def test_fleet_infeasible_target_excluded():
+    spec = _spec_multi()
+    # impossible ITL: below every alpha
+    for sc in spec.service_classes:
+        sc.model_targets[0] = type(sc.model_targets[0])(
+            model=sc.model_targets[0].model, slo_itl=1.0, slo_ttft=0.0, slo_tps=0.0
+        )
+    fleet = _fleet_system(spec)
+    for server in fleet.servers.values():
+        assert server.all_allocations == {}
+
+
+def test_fleet_keep_accelerator_pins():
+    from inferno_tpu.config import AllocationData
+
+    srv = make_server(current=AllocationData(accelerator="v5p-8", num_replicas=1))
+    srv.keep_accelerator = True
+    spec = make_system_spec([srv])
+    fleet = _fleet_system(spec)
+    assert set(fleet.servers[srv.name].all_allocations) == {"v5p-8"}
+
+
+def test_fleet_sharded_over_mesh_matches_unsharded():
+    spec = _spec_multi()
+    plain = _fleet_system(spec)
+    mesh = fleet_mesh()  # 8 virtual CPU devices from conftest
+    assert mesh.size == 8
+    sharded = _fleet_system(spec, mesh=mesh)
+    for name, p_server in plain.servers.items():
+        s_server = sharded.servers[name]
+        assert set(p_server.all_allocations) == set(s_server.all_allocations)
+        for acc in p_server.all_allocations:
+            assert (
+                p_server.all_allocations[acc].num_replicas
+                == s_server.all_allocations[acc].num_replicas
+            )
+
+
+def test_build_fleet_pads_lanes():
+    spec = _spec_multi()
+    system = System(spec)
+    plan = build_fleet(system, pad_to=8)
+    assert plan.num_lanes == 9  # 3 servers x 3 shapes
+    assert plan.params.alpha.shape[0] == 16  # padded to multiple of 8
+    assert plan.k_max % 128 == 0
+
+
+def test_fleet_end_to_end_with_solver():
+    from inferno_tpu.solver import optimize
+
+    spec = _spec_multi()
+    system = _fleet_system(spec)
+    result = optimize(system, spec.optimizer)
+    assert set(result.solution) == {s.name for s in spec.servers}
+    for data in result.solution.values():
+        assert data.num_replicas >= 1

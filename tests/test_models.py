@@ -1,0 +1,100 @@
+"""Tests for performance models: linear profile fitting and the learned
+surrogate (sharded training)."""
+
+import numpy as np
+import pytest
+
+from inferno_tpu.models import fit_profile
+from inferno_tpu.models.surrogate import (
+    N_FEATURES,
+    N_OUTPUTS,
+    SurrogateConfig,
+    featurize,
+    init_surrogate,
+    surrogate_forward,
+    surrogate_param_specs,
+)
+
+
+def test_fit_profile_recovers_exact_line():
+    batch = np.array([1, 8, 16, 32, 64], dtype=np.float64)
+    itl = 7.0 + 0.027 * batch  # the reference tutorial's fitted Llama-8B curve
+    in_tok = np.array([128, 256, 512, 1024, 2048], dtype=np.float64)
+    pb = np.array([1, 2, 4, 8, 16], dtype=np.float64)
+    prefill = 5.2 + 0.1 * in_tok * pb
+    fp = fit_profile(batch, itl, pb, in_tok, prefill)
+    assert fp.decode.alpha == pytest.approx(7.0, rel=1e-9)
+    assert fp.decode.beta == pytest.approx(0.027, rel=1e-9)
+    assert fp.prefill.gamma == pytest.approx(5.2, rel=1e-6)
+    assert fp.prefill.delta == pytest.approx(0.1, rel=1e-9)
+    assert fp.decode_rmse < 1e-9
+
+
+def test_fit_profile_noisy_and_clamped():
+    rng = np.random.default_rng(0)
+    batch = np.linspace(1, 64, 50)
+    itl = 7.0 + 0.03 * batch + rng.normal(0, 0.05, 50)
+    fp = fit_profile(batch, itl, batch, np.full(50, 128.0), 5.0 + 0.01 * 128 * batch)
+    assert fp.decode.alpha == pytest.approx(7.0, abs=0.15)
+    assert fp.decode.beta >= 0.0
+    with pytest.raises(ValueError):
+        fit_profile([1.0], [7.0], batch, batch, batch)
+
+
+def test_fit_profile_to_perf_spec():
+    fp = fit_profile([1, 64], [7.0, 8.7], [1, 8], [512, 512], [10.0, 50.0])
+    spec = fp.to_perf_spec("llama", "v5e-8", max_batch_size=64, at_tokens=128)
+    assert spec.acc == "v5e-8"
+    assert spec.decode_parms.alpha == pytest.approx(fp.decode.alpha)
+
+
+def test_surrogate_forward_shapes_and_specs():
+    import jax
+
+    cfg = SurrogateConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64)
+    params = init_surrogate(jax.random.key(0), cfg)
+    x = np.zeros((5, N_FEATURES), np.float32)
+    out = surrogate_forward(params, x, cfg)
+    assert out.shape == (5, N_OUTPUTS)
+    # partition specs mirror the param tree exactly
+    specs = surrogate_param_specs(cfg)
+    jax.tree.map(lambda *_: None, params, specs,
+                 is_leaf=lambda x: not isinstance(x, (dict, list)))
+
+
+def test_featurize_shape():
+    n = 7
+    cols = [np.ones(n)] * 10
+    x = featurize(*cols)
+    assert x.shape == (n, N_FEATURES)
+    assert np.all(np.isfinite(x))
+
+
+def test_surrogate_learns_queueing_surface():
+    """The surrogate must be able to fit its own teacher: targets produced
+    by the scalar queueing analyzer."""
+    import jax
+
+    from inferno_tpu.analyzer import RequestSize, build_analyzer
+    from inferno_tpu.config.types import DecodeParms, PrefillParms
+    from inferno_tpu.parallel.train import fit_surrogate, train_mesh
+
+    rng = np.random.default_rng(1)
+    rows, targets = [], []
+    for _ in range(256):
+        alpha = rng.uniform(5, 20)
+        beta = rng.uniform(0.05, 0.4)
+        in_tok, out_tok = int(rng.integers(64, 512)), int(rng.integers(16, 128))
+        qa = build_analyzer(16, 160, DecodeParms(alpha, beta),
+                            PrefillParms(3.0, 0.02), RequestSize(in_tok, out_tok))
+        rate = rng.uniform(0.1, 0.9) * qa.max_rate
+        m = qa.analyze(rate)
+        rows.append([4, 1.2, alpha, beta, 3.0, 0.02, 16, in_tok, out_tok, rate])
+        targets.append([m.avg_token_time, m.ttft, m.throughput])
+    x = featurize(*np.array(rows, np.float32).T)
+    y = np.log1p(np.array(targets, np.float32))
+    mesh = train_mesh()  # 8 virtual devices -> (4, 2) dp x tp
+    assert mesh.devices.size == 8
+    state, losses = fit_surrogate(x, y, mesh=mesh, epochs=200, learning_rate=3e-3)
+    assert losses[-1] < losses[0] * 0.2  # clear learning signal
+    assert np.isfinite(losses[-1])
