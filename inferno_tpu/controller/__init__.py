@@ -1,0 +1,17 @@
+from inferno_tpu.controller.crd import (
+    VariantAutoscaling,
+    VariantAutoscalingSpec,
+    VariantAutoscalingStatus,
+)
+from inferno_tpu.controller.kube import InMemoryCluster, KubeClient
+from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+
+__all__ = [
+    "VariantAutoscaling",
+    "VariantAutoscalingSpec",
+    "VariantAutoscalingStatus",
+    "InMemoryCluster",
+    "KubeClient",
+    "Reconciler",
+    "ReconcilerConfig",
+]

@@ -1,0 +1,51 @@
+"""Actuator: publish scaling decisions for HPA/KEDA to enact.
+
+Like the reference (internal/actuator/actuator.go:50-84), the controller
+does NOT scale Deployments directly: it emits the inferno_* gauges that
+prometheus-adapter/KEDA feed into HPA. Optionally (flagged), it can
+scale the Deployment itself for environments without an external
+actuator — useful with the in-memory cluster and the emulator e2e.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from inferno_tpu.controller.crd import VariantAutoscaling
+from inferno_tpu.controller.kube import KubeClient, KubeError
+from inferno_tpu.controller.metrics import MetricsEmitter
+
+
+@dataclasses.dataclass
+class Actuator:
+    kube: KubeClient
+    emitter: MetricsEmitter
+    direct_scale: bool = False  # scale Deployments directly (no HPA present)
+
+    def current_replicas(self, va: VariantAutoscaling) -> int:
+        """Observed replicas from the owning Deployment (same name/ns)
+        (reference getCurrentDeploymentReplicas: actuator.go:29-48)."""
+        deploy = self.kube.get_deployment(va.namespace, va.name)
+        status = deploy.get("status", {}) or {}
+        if "readyReplicas" in status:
+            return int(status.get("readyReplicas") or 0)
+        return int(deploy.get("spec", {}).get("replicas", 0) or 0)
+
+    def emit_metrics(self, va: VariantAutoscaling) -> None:
+        """(reference EmitMetrics: actuator.go:50-84); failures must not
+        fail the reconcile cycle (actuator.go:69-74) — callers catch."""
+        current = self.current_replicas(va)
+        desired = va.status.desired_optimized_alloc.num_replicas
+        accelerator = va.status.desired_optimized_alloc.accelerator
+        self.emitter.emit_replica_metrics(
+            namespace=va.namespace,
+            variant=va.name,
+            accelerator=accelerator,
+            current=current,
+            desired=desired,
+        )
+        if self.direct_scale and desired != current:
+            try:
+                self.kube.scale_deployment(va.namespace, va.name, desired)
+            except KubeError:
+                pass  # next cycle retries; metrics already emitted

@@ -1,0 +1,159 @@
+"""Load/metrics collection from the serving engines via Prometheus.
+
+Capability parity with /root/reference/internal/collector/collector.go:
+87-285, engine-pluggable (vllm-tpu / jetstream vocabularies from
+`inferno_tpu.controller.engines`) instead of hardcoded vLLM names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+
+from inferno_tpu.controller.crd import (
+    REASON_METRICS_FOUND,
+    REASON_METRICS_MISSING,
+    REASON_METRICS_STALE,
+    REASON_PROMETHEUS_ERROR,
+    ACCELERATOR_LABEL,
+    CurrentAlloc,
+    LoadProfile,
+    VariantAutoscaling,
+)
+from inferno_tpu.controller.engines import (
+    LABEL_NAMESPACE,
+    EngineMetrics,
+)
+from inferno_tpu.controller.promclient import PromClient, PromError, Sample
+
+STALENESS_LIMIT_SECONDS = 300.0  # 5 min (reference: collector.go:139-149)
+
+# reference hardcodes 256 pending server-reported value (collector.go:257-259)
+DEFAULT_MAX_BATCH = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricsValidation:
+    """(reference MetricsValidationResult: collector.go:79-84)"""
+
+    available: bool
+    reason: str
+    message: str
+
+
+def fix_value(x: float) -> float:
+    """NaN/Inf -> 0 (reference FixValue: collector.go:281-285)."""
+    if math.isnan(x) or math.isinf(x):
+        return 0.0
+    return x
+
+
+def _selector(engine: EngineMetrics, model: str, namespace: str | None) -> str:
+    parts = [f'{engine.model_label}="{model}"']
+    if namespace is not None:
+        parts.append(f'{LABEL_NAMESPACE}="{namespace}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _rate_ratio(engine: EngineMetrics, num: str, den: str, model: str, ns: str) -> str:
+    sel = _selector(engine, model, ns)
+    return f"sum(rate({num}{sel}[1m]))/sum(rate({den}{sel}[1m]))"
+
+
+def _first_value(samples: list[Sample]) -> float:
+    return fix_value(samples[0].value) if samples else 0.0
+
+
+def validate_metrics_availability(
+    prom: PromClient, engine: EngineMetrics, model: str, namespace: str
+) -> MetricsValidation:
+    """Probe one engine series for presence and freshness, with a
+    namespace-less fallback for emulators
+    (reference ValidateMetricsAvailability: collector.go:87-156)."""
+    query = f"{engine.num_requests_running}{_selector(engine, model, namespace)}"
+    try:
+        samples = prom.query(query)
+    except PromError as e:
+        return MetricsValidation(False, REASON_PROMETHEUS_ERROR, f"Failed to query Prometheus: {e}")
+
+    if not samples:
+        fallback = f"{engine.num_requests_running}{_selector(engine, model, None)}"
+        try:
+            samples = prom.query(fallback)
+        except PromError as e:
+            return MetricsValidation(
+                False, REASON_PROMETHEUS_ERROR, f"Failed to query Prometheus: {e}"
+            )
+        if not samples:
+            return MetricsValidation(
+                False,
+                REASON_METRICS_MISSING,
+                f"No {engine.name} metrics found for model '{model}' in namespace "
+                f"'{namespace}'. Check ServiceMonitor configuration and that serving "
+                "pods expose /metrics.",
+            )
+
+    now = time.time()
+    for s in samples:
+        age = now - s.timestamp
+        if age > STALENESS_LIMIT_SECONDS:
+            return MetricsValidation(
+                False,
+                REASON_METRICS_STALE,
+                f"{engine.name} metrics for model '{model}' are stale "
+                f"(last update {age:.0f}s ago).",
+            )
+    return MetricsValidation(
+        True, REASON_METRICS_FOUND, f"{engine.name} metrics are available and fresh"
+    )
+
+
+def collect_current_alloc(
+    prom: PromClient,
+    engine: EngineMetrics,
+    va: VariantAutoscaling,
+    deployment: dict,
+    accelerator_cost: float,
+) -> CurrentAlloc:
+    """Build the observed CurrentAlloc from five Prometheus queries plus
+    Deployment state (reference AddMetricsToOptStatus: collector.go:158-278).
+
+    Raises PromError on query failure (callers skip the variant for this
+    cycle, like the reference).
+    """
+    ns = deployment.get("metadata", {}).get("namespace", va.namespace)
+    model = va.spec.model_id
+    sel = _selector(engine, model, ns)
+
+    arrival = _first_value(
+        prom.query(f"sum(rate({engine.request_success_total}{sel}[1m]))")
+    ) * 60.0  # req/sec -> req/min (collector.go:217)
+    avg_in = _first_value(
+        prom.query(_rate_ratio(engine, engine.prompt_tokens_sum, engine.prompt_tokens_count, model, ns))
+    )
+    avg_out = _first_value(
+        prom.query(_rate_ratio(engine, engine.generation_tokens_sum, engine.generation_tokens_count, model, ns))
+    )
+    ttft_ms = _first_value(
+        prom.query(_rate_ratio(engine, engine.ttft_seconds_sum, engine.ttft_seconds_count, model, ns))
+    ) * 1000.0
+    itl_ms = _first_value(
+        prom.query(_rate_ratio(engine, engine.tpot_seconds_sum, engine.tpot_seconds_count, model, ns))
+    ) * 1000.0
+
+    replicas = int(deployment.get("spec", {}).get("replicas", 0) or 0)
+    accelerator = va.labels.get(ACCELERATOR_LABEL, "")
+    return CurrentAlloc(
+        accelerator=accelerator,
+        num_replicas=replicas,
+        max_batch=DEFAULT_MAX_BATCH,
+        variant_cost=replicas * accelerator_cost,
+        itl_average=itl_ms,
+        ttft_average=ttft_ms,
+        load=LoadProfile(
+            arrival_rate=arrival,
+            avg_input_tokens=avg_in,
+            avg_output_tokens=avg_out,
+        ),
+    )

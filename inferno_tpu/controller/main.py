@@ -1,0 +1,110 @@
+"""Controller process entry point.
+
+The analogue of the reference's manager main
+(/root/reference/cmd/main.go:62-279): env/flag configuration, Prometheus
+client with TLS validation, metrics + health endpoints, then the
+interval-driven reconcile loop. Leader election is delegated to the
+Deployment (replicas: 1) in this build; the loop is stateless so a
+restart resumes cleanly from CR status (SURVEY §5.4).
+
+Environment (reference parity: internal/utils/tls.go:101-118 and
+controller.go:516-582):
+  PROMETHEUS_BASE_URL           https://... (required; http only with
+                                PROMETHEUS_ALLOW_HTTP=true, test envs)
+  PROMETHEUS_BEARER_TOKEN[_FILE]
+  PROMETHEUS_CA_CERT_PATH, PROMETHEUS_CLIENT_CERT_PATH/KEY_PATH
+  PROMETHEUS_TLS_INSECURE_SKIP_VERIFY=true|false
+  WVA_SCALE_TO_ZERO=true|false
+  CONFIG_NAMESPACE              (default inferno-system)
+  SERVING_ENGINE                vllm-tpu | jetstream
+  METRICS_PORT                  (default 8443)
+  USE_TPU_FLEET                 true|false (default true)
+  DIRECT_SCALE                  true|false (default false; HPA otherwise)
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name, "")
+    if not v:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def prom_config_from_env():
+    from inferno_tpu.controller.promclient import PromConfig
+
+    return PromConfig(
+        base_url=os.environ.get("PROMETHEUS_BASE_URL", ""),
+        bearer_token=os.environ.get("PROMETHEUS_BEARER_TOKEN", ""),
+        bearer_token_file=os.environ.get("PROMETHEUS_BEARER_TOKEN_FILE", ""),
+        ca_file=os.environ.get("PROMETHEUS_CA_CERT_PATH", ""),
+        client_cert_file=os.environ.get("PROMETHEUS_CLIENT_CERT_PATH", ""),
+        client_key_file=os.environ.get("PROMETHEUS_CLIENT_KEY_PATH", ""),
+        insecure_skip_verify=env_bool("PROMETHEUS_TLS_INSECURE_SKIP_VERIFY"),
+        allow_http=env_bool("PROMETHEUS_ALLOW_HTTP"),
+    )
+
+
+def main() -> int:
+    from inferno_tpu.controller.kube import RestKubeClient
+    from inferno_tpu.controller.metrics import MetricsEmitter, MetricsServer, Registry
+    from inferno_tpu.controller.promclient import HttpPromClient
+    from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+
+    prom_cfg = prom_config_from_env()
+    if not prom_cfg.base_url:
+        print("PROMETHEUS_BASE_URL is required", file=sys.stderr)
+        return 2
+    prom = HttpPromClient(prom_cfg)
+    # connectivity gate with backoff (reference: utils.go:390-410 called
+    # from SetupWithManager; 5s doubling)
+    delay = 5.0
+    for _ in range(6):
+        if prom.healthy():
+            break
+        print(f"prometheus not reachable; retrying in {delay}s", file=sys.stderr)
+        time.sleep(delay)
+        delay *= 2
+    else:
+        print("prometheus unreachable; exiting", file=sys.stderr)
+        return 1
+
+    kube = RestKubeClient()
+    registry = Registry()
+    emitter = MetricsEmitter(registry)
+    server = MetricsServer(registry, port=int(os.environ.get("METRICS_PORT", "8443")))
+    server.start()
+
+    config = ReconcilerConfig(
+        config_namespace=os.environ.get("CONFIG_NAMESPACE", "inferno-system"),
+        engine=os.environ.get("SERVING_ENGINE", "vllm-tpu"),
+        scale_to_zero=env_bool("WVA_SCALE_TO_ZERO"),
+        use_tpu_fleet=env_bool("USE_TPU_FLEET", True),
+        direct_scale=env_bool("DIRECT_SCALE"),
+    )
+    rec = Reconciler(kube=kube, prom=prom, config=config, emitter=emitter)
+
+    stopping = {"stop": False}
+
+    def _stop(_sig, _frm):
+        stopping["stop"] = True
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+
+    try:
+        rec.run_forever(stop_check=lambda: stopping["stop"])
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,179 @@
+"""Prometheus metrics exposition for the controller's own outputs.
+
+Minimal stdlib registry (the actuation contract is just four series,
+reference: internal/metrics/metrics.go:20-65): gauges + a counter with
+labels, rendered in the text exposition format and served over HTTP
+together with health probes (reference serves these via
+controller-runtime, cmd/main.go:157-169, 250-257).
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Iterable
+
+from inferno_tpu.controller.engines import (
+    LABEL_ACCELERATOR,
+    LABEL_DIRECTION,
+    LABEL_OUT_NAMESPACE,
+    LABEL_VARIANT,
+    METRIC_CURRENT_REPLICAS,
+    METRIC_DESIRED_RATIO,
+    METRIC_DESIRED_REPLICAS,
+    METRIC_SCALING_TOTAL,
+)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Series:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind  # "gauge" | "counter"
+        self.values: dict[tuple, tuple[dict[str, str], float]] = {}
+
+    def _key(self, labels: dict[str, str]) -> tuple:
+        return tuple(sorted(labels.items()))
+
+    def set(self, labels: dict[str, str], value: float) -> None:
+        self.values[self._key(labels)] = (labels, value)
+
+    def inc(self, labels: dict[str, str], by: float = 1.0) -> None:
+        key = self._key(labels)
+        old = self.values.get(key, (labels, 0.0))[1]
+        self.values[key] = (labels, old + by)
+
+    def get(self, labels: dict[str, str]) -> float | None:
+        v = self.values.get(self._key(labels))
+        return v[1] if v else None
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        for labels, value in self.values.values():
+            yield f"{self.name}{_fmt_labels(labels)} {value}"
+
+
+class Registry:
+    def __init__(self):
+        self._series: dict[str, _Series] = {}
+        self._lock = threading.Lock()
+
+    def gauge(self, name: str, help_: str = "") -> _Series:
+        return self._get(name, help_, "gauge")
+
+    def counter(self, name: str, help_: str = "") -> _Series:
+        return self._get(name, help_, "counter")
+
+    def _get(self, name: str, help_: str, kind: str) -> _Series:
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                s = _Series(name, help_, kind)
+                self._series[name] = s
+            return s
+
+    def render(self) -> str:
+        with self._lock:
+            lines: list[str] = []
+            for s in self._series.values():
+                lines.extend(s.render())
+        return "\n".join(lines) + "\n"
+
+
+class MetricsEmitter:
+    """The four actuation series
+    (reference MetricsEmitter: internal/metrics/metrics.go:68-126)."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self.scaling_total = self.registry.counter(
+            METRIC_SCALING_TOTAL, "Replica scaling decisions by direction"
+        )
+        self.desired_replicas = self.registry.gauge(
+            METRIC_DESIRED_REPLICAS, "Optimizer-desired replicas per variant"
+        )
+        self.current_replicas = self.registry.gauge(
+            METRIC_CURRENT_REPLICAS, "Observed replicas per variant"
+        )
+        self.desired_ratio = self.registry.gauge(
+            METRIC_DESIRED_RATIO, "desired/current ratio (0->N encoded as N)"
+        )
+
+    def emit_replica_metrics(
+        self,
+        namespace: str,
+        variant: str,
+        accelerator: str,
+        current: int,
+        desired: int,
+    ) -> None:
+        """(reference EmitReplicaMetrics: internal/metrics/metrics.go:103-126)"""
+        labels = {
+            LABEL_OUT_NAMESPACE: namespace,
+            LABEL_VARIANT: variant,
+            LABEL_ACCELERATOR: accelerator,
+        }
+        self.desired_replicas.set(labels, float(desired))
+        self.current_replicas.set(labels, float(current))
+        # scale-from-zero: ratio encodes the absolute target
+        # (internal/metrics/metrics.go:118-124)
+        ratio = float(desired) if current == 0 else float(desired) / float(current)
+        self.desired_ratio.set(labels, ratio)
+        if desired != current:
+            direction = "up" if desired > current else "down"
+            self.scaling_total.inc({**labels, LABEL_DIRECTION: direction})
+
+
+class MetricsServer:
+    """Serves /metrics, /healthz, /readyz on a background thread."""
+
+    def __init__(self, registry: Registry, port: int = 8443, host: str = ""):
+        self.registry = registry
+        registry_ref = registry
+        ready_flag = {"ready": True}
+        self.ready_flag = ready_flag
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/metrics":
+                    body = registry_ref.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                elif self.path == "/healthz":
+                    body = b"ok"
+                    self.send_response(200)
+                elif self.path == "/readyz":
+                    ok = ready_flag["ready"]
+                    body = b"ok" if ok else b"not ready"
+                    self.send_response(200 if ok else 503)
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request logging
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,161 @@
+"""Prometheus query clients.
+
+`PromClient` is the two-method interface the collector needs. The HTTP
+implementation enforces the reference's transport rules
+(/root/reference/internal/utils/{tls.go,prometheus_transport.go}):
+HTTPS-only unless explicitly allowed, TLS >= 1.2, optional CA bundle and
+mTLS client certs, bearer token from value or file. `FakeProm` serves
+canned or computed samples for tests (the analogue of MockPromAPI,
+/root/reference/test/utils/unitutils.go:137-241).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import ssl
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Protocol
+
+
+class PromError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Sample:
+    labels: dict[str, str]
+    value: float
+    timestamp: float  # unix seconds
+
+
+class PromClient(Protocol):
+    def query(self, promql: str) -> list[Sample]: ...
+
+    def healthy(self) -> bool: ...
+
+
+@dataclasses.dataclass
+class PromConfig:
+    """(reference PrometheusConfig: internal/interfaces/types.go:33-47)"""
+
+    base_url: str = ""
+    bearer_token: str = ""
+    bearer_token_file: str = ""
+    ca_file: str = ""
+    client_cert_file: str = ""
+    client_key_file: str = ""
+    insecure_skip_verify: bool = False
+    allow_http: bool = False  # reference enforces https (tls.go:63-68)
+
+
+class HttpPromClient:
+    def __init__(self, config: PromConfig):
+        url = urllib.parse.urlparse(config.base_url)
+        if url.scheme != "https" and not (config.allow_http and url.scheme == "http"):
+            raise PromError(
+                f"Prometheus URL must use https (got {config.base_url!r}); "
+                "set allow_http for test environments only"
+            )
+        self.config = config
+        if url.scheme == "http":
+            self.ctx = None
+        elif config.insecure_skip_verify:
+            self.ctx = ssl._create_unverified_context()  # noqa: S323 — explicit opt-in
+        else:
+            self.ctx = ssl.create_default_context(
+                cafile=config.ca_file or None
+            )
+            self.ctx.minimum_version = ssl.TLSVersion.TLSv1_2  # tls.go:27
+            if config.client_cert_file and config.client_key_file:
+                self.ctx.load_cert_chain(
+                    config.client_cert_file, config.client_key_file
+                )
+
+    def _token(self) -> str:
+        if self.config.bearer_token:
+            return self.config.bearer_token
+        if self.config.bearer_token_file:
+            with open(self.config.bearer_token_file) as f:
+                return f.read().strip()
+        return ""
+
+    def query(self, promql: str) -> list[Sample]:
+        qs = urllib.parse.urlencode({"query": promql})
+        req = urllib.request.Request(
+            f"{self.config.base_url.rstrip('/')}/api/v1/query?{qs}"
+        )
+        token = self._token()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, context=self.ctx, timeout=30) as resp:
+                payload = json.loads(resp.read())
+        except (urllib.error.URLError, TimeoutError, json.JSONDecodeError) as e:
+            raise PromError(f"query failed: {e}") from e
+        if payload.get("status") != "success":
+            raise PromError(f"query error: {payload.get('error', 'unknown')}")
+        data = payload.get("data", {})
+        if data.get("resultType") != "vector":
+            return []
+        out = []
+        for item in data.get("result", []):
+            ts, val = item.get("value", [time.time(), "0"])
+            try:
+                fval = float(val)
+            except ValueError:
+                fval = 0.0
+            out.append(
+                Sample(labels=dict(item.get("metric", {})), value=fval, timestamp=float(ts))
+            )
+        return out
+
+    def healthy(self) -> bool:
+        """Connectivity gate via an `up` query
+        (reference ValidatePrometheusAPI: internal/utils/utils.go:390-410)."""
+        try:
+            self.query("up")
+            return True
+        except PromError:
+            return False
+
+
+class FakeProm:
+    """Canned results keyed by exact query string, plus optional dynamic
+    handlers; unknown queries return empty vectors or raise if configured."""
+
+    def __init__(self):
+        self.results: dict[str, list[Sample]] = {}
+        self.errors: dict[str, Exception] = {}
+        self.handlers: list[tuple[Callable[[str], bool], Callable[[str], list[Sample]]]] = []
+        self.queries: list[str] = []
+        self.is_healthy = True
+
+    def set_result(self, promql: str, value: float, labels: dict | None = None,
+                   age_seconds: float = 0.0) -> None:
+        self.results[promql] = [
+            Sample(labels=labels or {}, value=value, timestamp=time.time() - age_seconds)
+        ]
+
+    def set_error(self, promql: str, err: Exception) -> None:
+        self.errors[promql] = err
+
+    def add_handler(self, match, handler) -> None:
+        self.handlers.append((match, handler))
+
+    def query(self, promql: str) -> list[Sample]:
+        self.queries.append(promql)
+        if promql in self.errors:
+            raise self.errors[promql]
+        if promql in self.results:
+            return self.results[promql]
+        for match, handler in self.handlers:
+            if match(promql):
+                return handler(promql)
+        return []
+
+    def healthy(self) -> bool:
+        return self.is_healthy

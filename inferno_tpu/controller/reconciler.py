@@ -1,0 +1,445 @@
+"""The reconcile loop.
+
+Capability parity with the reference controller
+(/root/reference/internal/controller/variantautoscaling_controller.go:
+86-407), same cycle shape (SURVEY §3.2):
+
+  read config -> list VAs -> per-VA prepare (SLO lookup, profiles,
+  deployment, owner-ref, metrics validation, load collection) ->
+  build System -> size candidates (TPU fleet path) -> solve ->
+  per-VA apply (status + conditions + actuation metrics)
+
+Per-VA errors skip that variant for the cycle; optimization failure
+marks OptimizationReady=False on all VAs and retries next cycle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any
+
+import yaml
+
+from inferno_tpu.config.types import (
+    AcceleratorSpec,
+    AllocationData,
+    CapacitySpec,
+    ModelTarget,
+    OptimizerSpec,
+    ServerLoadSpec,
+    ServerSpec,
+    ServiceClassSpec,
+    SystemSpec,
+)
+from inferno_tpu.controller.actuator import Actuator
+from inferno_tpu.controller.collector import (
+    collect_current_alloc,
+    validate_metrics_availability,
+)
+from inferno_tpu.controller.crd import (
+    GROUP,
+    REASON_METRICS_UNAVAILABLE,
+    REASON_OPTIMIZATION_FAILED,
+    REASON_OPTIMIZATION_SUCCEEDED,
+    TYPE_METRICS_AVAILABLE,
+    TYPE_OPTIMIZATION_READY,
+    VERSION,
+    VariantAutoscaling,
+    _utcnow,
+)
+from inferno_tpu.controller.engines import EngineMetrics, engine_for
+from inferno_tpu.controller.kube import KubeClient, KubeError, NotFound
+from inferno_tpu.controller.promclient import PromClient, PromError
+from inferno_tpu.core import System
+from inferno_tpu.solver import Optimizer
+
+DEFAULT_INTERVAL_SECONDS = 60  # reference: variantautoscaling_controller.go:94-101
+
+# ConfigMap names (reference: variantautoscaling_controller.go:490-514, 584-594)
+CM_CONFIG = "inferno-autoscaler-config"
+CM_ACCELERATOR_COSTS = "accelerator-unit-costs"
+CM_SERVICE_CLASSES = "service-classes-config"
+
+
+@dataclasses.dataclass
+class ReconcilerConfig:
+    config_namespace: str = "inferno-system"
+    engine: str = "vllm-tpu"  # serving engine metric vocabulary
+    scale_to_zero: bool = False  # reference env WVA_SCALE_TO_ZERO (utils.go:282-285)
+    use_tpu_fleet: bool = True  # batched sizing vs scalar loop
+    direct_scale: bool = False  # actuate Deployments directly (no HPA)
+    interval_seconds: int = DEFAULT_INTERVAL_SECONDS
+
+
+@dataclasses.dataclass
+class CycleReport:
+    """What one reconcile cycle did (returned for tests/observability)."""
+
+    interval_seconds: int
+    variants_seen: int = 0
+    variants_prepared: int = 0
+    variants_applied: int = 0
+    optimization_ok: bool = True
+    solver_ms: float = 0.0
+    analysis_ms: float = 0.0
+    errors: list[str] = dataclasses.field(default_factory=list)
+
+
+class Reconciler:
+    def __init__(
+        self,
+        kube: KubeClient,
+        prom: PromClient,
+        config: ReconcilerConfig | None = None,
+        emitter=None,
+    ):
+        from inferno_tpu.controller.metrics import MetricsEmitter
+
+        self.kube = kube
+        self.prom = prom
+        self.config = config or ReconcilerConfig()
+        self.emitter = emitter or MetricsEmitter()
+        self.actuator = Actuator(
+            kube=kube, emitter=self.emitter, direct_scale=self.config.direct_scale
+        )
+
+    # -- config reading -----------------------------------------------------
+
+    def _read_cm(self, name: str) -> dict[str, str]:
+        try:
+            return self.kube.get_configmap(self.config.config_namespace, name)
+        except NotFound:
+            return {}
+
+    def read_interval(self) -> int:
+        """(reference readOptimizationConfig: controller.go:584-594)"""
+        data = self._read_cm(CM_CONFIG)
+        try:
+            return int(data.get("GLOBAL_OPT_INTERVAL", "").rstrip("s") or 0) or (
+                self.config.interval_seconds
+            )
+        except ValueError:
+            return self.config.interval_seconds
+
+    def read_accelerators(self) -> list[AcceleratorSpec]:
+        """Slice-shape catalog with per-chip-hour costs
+        (reference readAcceleratorConfig: controller.go:499-514, JSON value
+        per accelerator type)."""
+        data = self._read_cm(CM_ACCELERATOR_COSTS)
+        out = []
+        for name, raw in sorted(data.items()):
+            try:
+                obj = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            out.append(
+                AcceleratorSpec(
+                    name=name,
+                    cost_per_chip_hr=float(obj.get("cost", 0.0) or 0.0),
+                    mem_per_chip_gb=float(obj.get("memPerChipGB", 16.0) or 16.0),
+                )
+            )
+        return out
+
+    def read_service_classes(self) -> list[ServiceClassSpec]:
+        """YAML documents, one per ConfigMap key
+        (reference shape: internal/interfaces/types.go:20-30)."""
+        data = self._read_cm(CM_SERVICE_CLASSES)
+        out = []
+        for _, raw in sorted(data.items()):
+            try:
+                doc = yaml.safe_load(raw)
+            except yaml.YAMLError:
+                continue
+            if not isinstance(doc, dict) or "name" not in doc:
+                continue
+            targets = []
+            for entry in doc.get("data", []) or []:
+                targets.append(
+                    ModelTarget(
+                        model=str(entry.get("model", "")),
+                        slo_itl=float(entry.get("slo-tpot", 0) or 0),
+                        slo_ttft=float(entry.get("slo-ttft", 0) or 0),
+                        slo_tps=float(entry.get("slo-tps", 0) or 0),
+                    )
+                )
+            out.append(
+                ServiceClassSpec(
+                    name=str(doc["name"]),
+                    priority=int(doc.get("priority", 100) or 100),
+                    model_targets=targets,
+                )
+            )
+        return out
+
+    def read_optimizer_and_capacity(self) -> tuple[OptimizerSpec, CapacitySpec]:
+        data = self._read_cm(CM_CONFIG)
+        optimizer = OptimizerSpec(
+            unlimited=(data.get("OPTIMIZER_MODE", "unlimited").lower() != "limited"),
+            saturation_policy=data.get("SATURATION_POLICY", "None"),
+        )
+        capacity = CapacitySpec()
+        raw = data.get("TPU_CAPACITY", "")
+        if raw:
+            try:
+                capacity = CapacitySpec(
+                    chips={k: int(v) for k, v in json.loads(raw).items()}
+                )
+            except (json.JSONDecodeError, ValueError, AttributeError):
+                pass
+        return optimizer, capacity
+
+    # -- per-VA preparation -------------------------------------------------
+
+    def _find_slo(
+        self, classes: list[ServiceClassSpec], va: VariantAutoscaling
+    ) -> tuple[str, ModelTarget] | None:
+        """Service class + target for the VA's model. The sloClassRef names
+        the preferred class; otherwise first class listing the model wins
+        (reference FindModelSLO: internal/utils/utils.go:369-383)."""
+        preferred = va.spec.slo_class_ref.key or va.spec.slo_class_ref.name
+        for sc in classes:
+            if sc.name == preferred:
+                t = sc.target_for(va.spec.model_id)
+                if t is not None:
+                    return sc.name, t
+        for sc in classes:
+            t = sc.target_for(va.spec.model_id)
+            if t is not None:
+                return sc.name, t
+        return None
+
+    def _set_owner_reference(self, va: VariantAutoscaling, deployment: dict) -> None:
+        """Deployment owns the VA so deleting it GCs the VA
+        (reference: controller.go:276-293)."""
+        uid = deployment.get("metadata", {}).get("uid", "")
+        ref = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "name": deployment.get("metadata", {}).get("name", va.name),
+            "uid": uid,
+            "controller": True,
+            "blockOwnerDeletion": False,
+        }
+        for existing in va.owner_references:
+            if existing.get("kind") == "Deployment" and existing.get("name") == ref["name"]:
+                return
+        va.owner_references.append(ref)
+        try:
+            self.kube.patch_variant_autoscaling_meta(va)
+        except KubeError:
+            pass  # retried next cycle
+
+    def prepare(
+        self,
+        va: VariantAutoscaling,
+        engine: EngineMetrics,
+        classes: list[ServiceClassSpec],
+        accelerators: dict[str, AcceleratorSpec],
+        spec: SystemSpec,
+        report: CycleReport,
+    ) -> bool:
+        """Prepare one VA into the system spec
+        (reference prepareVariantAutoscalings: controller.go:218-335).
+        Returns True if the VA was added as a server."""
+        slo = self._find_slo(classes, va)
+        if slo is None:
+            report.errors.append(f"{va.full_name}: no SLO entry for model {va.spec.model_id}")
+            return False
+        class_name, _ = slo
+
+        # per-accelerator perf profiles from the CR
+        # (reference AddModelAcceleratorProfileToSystemData: utils.go:185-234)
+        added_profile = False
+        for prof in va.spec.accelerators:
+            if prof.acc not in accelerators:
+                continue
+            spec.models.append(prof.to_perf_spec(va.spec.model_id))
+            added_profile = True
+        if not added_profile:
+            report.errors.append(f"{va.full_name}: no profile matches a known slice shape")
+            return False
+
+        try:
+            deployment = self.kube.get_deployment(va.namespace, va.name)
+        except KubeError as e:
+            report.errors.append(f"{va.full_name}: deployment: {e}")
+            return False
+        self._set_owner_reference(va, deployment)
+
+        validation = validate_metrics_availability(
+            self.prom, engine, va.spec.model_id, va.namespace
+        )
+        va.status.set_condition(
+            TYPE_METRICS_AVAILABLE,
+            "True" if validation.available else "False",
+            validation.reason,
+            validation.message,
+        )
+        if not validation.available:
+            va.status.set_condition(
+                TYPE_OPTIMIZATION_READY,
+                "False",
+                REASON_METRICS_UNAVAILABLE,
+                "metrics unavailable; skipping optimization for this variant",
+            )
+            try:
+                self.kube.update_variant_autoscaling_status(va)
+            except KubeError:
+                pass
+            return False
+
+        acc_name = va.labels.get("inference.optimization/acceleratorName", "")
+        cost = accelerators[acc_name].cost_per_chip_hr if acc_name in accelerators else 0.0
+        try:
+            current = collect_current_alloc(self.prom, engine, va, deployment, cost)
+        except PromError as e:
+            report.errors.append(f"{va.full_name}: collect: {e}")
+            return False
+        va.status.current_alloc = current
+
+        # server entry (reference AddServerInfoToSystemData: utils.go:237-311)
+        min_replicas = 0 if self.config.scale_to_zero else 1
+        spec.servers.append(
+            ServerSpec(
+                name=va.full_name,
+                class_name=class_name,
+                model=va.spec.model_id,
+                keep_accelerator=True,  # pinned across cycles (utils.go:290)
+                min_num_replicas=min_replicas,
+                current_alloc=AllocationData(
+                    accelerator=current.accelerator,
+                    num_replicas=current.num_replicas,
+                    max_batch=current.max_batch,
+                    cost=current.variant_cost,
+                    itl_average=current.itl_average,
+                    ttft_average=current.ttft_average,
+                    load=ServerLoadSpec(
+                        arrival_rate=current.load.arrival_rate,
+                        avg_in_tokens=int(current.load.avg_input_tokens),
+                        avg_out_tokens=int(current.load.avg_output_tokens),
+                    ),
+                ),
+            )
+        )
+        return True
+
+    # -- the cycle ----------------------------------------------------------
+
+    def run_cycle(self) -> CycleReport:
+        report = CycleReport(interval_seconds=self.read_interval())
+        engine = engine_for(self.config.engine)
+
+        accelerators = {a.name: a for a in self.read_accelerators()}
+        classes = self.read_service_classes()
+        optimizer_spec, capacity = self.read_optimizer_and_capacity()
+
+        try:
+            vas = [va for va in self.kube.list_variant_autoscalings() if va.active]
+        except KubeError as e:
+            report.errors.append(f"list: {e}")
+            report.optimization_ok = False
+            return report
+        report.variants_seen = len(vas)
+        if not vas:
+            return report
+
+        spec = SystemSpec(
+            accelerators=list(accelerators.values()),
+            service_classes=classes,
+            optimizer=optimizer_spec,
+            capacity=capacity,
+        )
+        prepared: list[VariantAutoscaling] = []
+        for va in vas:
+            if self.prepare(va, engine, classes, accelerators, spec, report):
+                prepared.append(va)
+        report.variants_prepared = len(prepared)
+        if not prepared:
+            return report
+
+        system = System(spec)
+        t0 = time.perf_counter()
+        try:
+            if self.config.use_tpu_fleet:
+                from inferno_tpu.parallel import calculate_fleet
+
+                calculate_fleet(system)
+            else:
+                system.calculate_all()
+            report.analysis_ms = (time.perf_counter() - t0) * 1000.0
+            result = Optimizer(optimizer_spec).optimize(system, calculate=False)
+            report.solver_ms = result.solution_time_msec
+            solution = result.solution
+        except Exception as e:  # optimization failed: mark all, retry next cycle
+            # (reference: controller.go:168-186)
+            report.optimization_ok = False
+            report.errors.append(f"optimize: {e}")
+            for va in prepared:
+                va.status.set_condition(
+                    TYPE_OPTIMIZATION_READY, "False", REASON_OPTIMIZATION_FAILED, str(e)
+                )
+                try:
+                    self.kube.update_variant_autoscaling_status(va)
+                except KubeError:
+                    pass
+            return report
+
+        self._apply(prepared, solution, report)
+        return report
+
+    def _apply(
+        self,
+        prepared: list[VariantAutoscaling],
+        solution: dict[str, Any],
+        report: CycleReport,
+    ) -> None:
+        """(reference applyOptimizedAllocations: controller.go:338-407)"""
+        now = _utcnow()
+        for va in prepared:
+            try:
+                fresh = self.kube.get_variant_autoscaling(va.namespace, va.name)
+            except KubeError as e:
+                report.errors.append(f"{va.full_name}: refetch: {e}")
+                continue
+            fresh.status = va.status
+            alloc = solution.get(va.full_name)
+            if alloc is not None:
+                fresh.status.desired_optimized_alloc.accelerator = alloc.accelerator
+                fresh.status.desired_optimized_alloc.num_replicas = alloc.num_replicas
+                fresh.status.desired_optimized_alloc.last_run_time = now
+                fresh.status.set_condition(
+                    TYPE_OPTIMIZATION_READY,
+                    "True",
+                    REASON_OPTIMIZATION_SUCCEEDED,
+                    "optimization completed",
+                )
+            else:
+                fresh.status.set_condition(
+                    TYPE_OPTIMIZATION_READY,
+                    "False",
+                    REASON_OPTIMIZATION_FAILED,
+                    "no feasible allocation (SLO unachievable or capacity exhausted)",
+                )
+            try:
+                self.actuator.emit_metrics(fresh)
+                fresh.status.actuation_applied = True
+            except KubeError as e:
+                # metric emission failure must not fail the cycle
+                # (reference: actuator.go:69-74)
+                report.errors.append(f"{va.full_name}: actuate: {e}")
+                fresh.status.actuation_applied = False
+            try:
+                self.kube.update_variant_autoscaling_status(fresh)
+                report.variants_applied += 1
+            except KubeError as e:
+                report.errors.append(f"{va.full_name}: status: {e}")
+
+    def run_forever(self, stop_check=lambda: False) -> None:
+        """Interval-driven steady state (the reference uses RequeueAfter,
+        controller.go:201)."""
+        while not stop_check():
+            report = self.run_cycle()
+            time.sleep(max(report.interval_seconds, 1))

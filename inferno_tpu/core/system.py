@@ -189,6 +189,10 @@ class System:
         self.servers: dict[str, Server] = {}
         self.capacity: dict[str, int] = {}  # available chips per pool
         self.pool_usage: dict[str, PoolUsage] = {}
+        # set by calculate_all / parallel.calculate_fleet; lets the
+        # optimizer's auto mode distinguish "never sized" from "sized and
+        # found infeasible" (empty all_allocations in both cases)
+        self.candidates_calculated = False
         if spec is not None:
             self.set_from_spec(spec)
 
@@ -211,6 +215,7 @@ class System:
         """Candidate allocations for every server (the analyzer hot loop)."""
         for server in self.servers.values():
             server.calculate(self)
+        self.candidates_calculated = True
 
     def allocate_by_pool(self) -> dict[str, PoolUsage]:
         """Accumulate chips and cost consumed per pool by the solved

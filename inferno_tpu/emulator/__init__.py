@@ -1,0 +1,14 @@
+from inferno_tpu.emulator.engine import EmulatedEngine, EngineProfile, RequestResult
+from inferno_tpu.emulator.loadgen import LoadGenerator, RateSpec
+from inferno_tpu.emulator.prom import EmulatorProm
+from inferno_tpu.emulator.server import EmulatorServer
+
+__all__ = [
+    "EmulatedEngine",
+    "EngineProfile",
+    "RequestResult",
+    "LoadGenerator",
+    "RateSpec",
+    "EmulatorProm",
+    "EmulatorServer",
+]

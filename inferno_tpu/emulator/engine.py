@@ -1,0 +1,169 @@
+"""Discrete-event emulation of a TPU continuous-batching inference engine.
+
+The analogue of the reference's vLLM emulator core
+(/root/reference/tools/vllm-emulator/vllm_model.py:46-467), modeling a
+JetStream/vLLM-TPU replica: a decode loop that admits waiting requests up
+to `max_batch` slots (KV memory permitting), where each iteration costs
+the linear latency profile
+
+    prefill(batch) = gamma + delta * in_tokens * batch      (msec)
+    decode(batch)  = alpha + beta * batch                   (msec)
+
+— the same curves the autoscaler's queueing model assumes, so closed-loop
+tests can check the whole stack against analytic expectations. A
+`time_scale` compresses emulated milliseconds to run e2e tests fast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineProfile:
+    alpha: float = 20.0  # msec
+    beta: float = 0.4
+    gamma: float = 5.0
+    delta: float = 0.02
+    max_batch: int = 64
+    kv_tokens_capacity: int = 1_000_000  # KV cache budget in tokens
+
+
+@dataclasses.dataclass
+class RequestResult:
+    ttft_ms: float
+    latency_ms: float
+    in_tokens: int
+    out_tokens: int
+
+
+@dataclasses.dataclass
+class _Request:
+    in_tokens: int
+    out_tokens: int
+    arrived: float
+    done_event: threading.Event = dataclasses.field(default_factory=threading.Event)
+    first_token_at: float | None = None
+    finished_at: float | None = None
+    tokens_done: int = 0
+    prefilled: bool = False
+
+
+class EmulatedEngine:
+    """One emulated replica, running its decode loop on a thread."""
+
+    def __init__(self, profile: EngineProfile, time_scale: float = 1.0):
+        """time_scale < 1 runs faster than real time (0.01 => 100x)."""
+        self.profile = profile
+        self.time_scale = time_scale
+        self.waiting: deque[_Request] = deque()
+        self.running: list[_Request] = []
+        self.lock = threading.Lock()
+        self.stop_flag = False
+        # telemetry event windows (timestamp, payload) for the fake scrape
+        self.arrivals: deque[float] = deque(maxlen=100_000)
+        self.completions: deque[tuple[float, RequestResult]] = deque(maxlen=100_000)
+        self.started_at = time.time()
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+
+    # -- public API ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.started_at = time.time()
+        self.thread.start()
+
+    def stop(self) -> None:
+        self.stop_flag = True
+        self.thread.join(timeout=5)
+
+    def submit(self, in_tokens: int, out_tokens: int) -> _Request:
+        req = _Request(in_tokens=in_tokens, out_tokens=max(out_tokens, 1), arrived=time.time())
+        with self.lock:
+            self.waiting.append(req)
+            self.arrivals.append(req.arrived)
+        return req
+
+    def generate(self, in_tokens: int, out_tokens: int, timeout: float = 60.0) -> RequestResult | None:
+        """Submit and block until completion (the /v1/chat path)."""
+        req = self.submit(in_tokens, out_tokens)
+        if not req.done_event.wait(timeout):
+            return None
+        assert req.first_token_at is not None and req.finished_at is not None
+        return RequestResult(
+            ttft_ms=(req.first_token_at - req.arrived) * 1000.0,
+            latency_ms=(req.finished_at - req.arrived) * 1000.0,
+            in_tokens=req.in_tokens,
+            out_tokens=req.out_tokens,
+        )
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    def kv_used_fraction(self) -> float:
+        with self.lock:
+            used = sum(r.in_tokens + r.tokens_done for r in self.running)
+        return min(used / self.profile.kv_tokens_capacity, 1.0)
+
+    # -- decode loop --------------------------------------------------------
+
+    def _admit(self) -> None:
+        with self.lock:
+            kv_used = sum(r.in_tokens + r.tokens_done for r in self.running)
+            while self.waiting and len(self.running) < self.profile.max_batch:
+                nxt = self.waiting[0]
+                if kv_used + nxt.in_tokens + nxt.out_tokens > self.profile.kv_tokens_capacity:
+                    break  # KV admission control (vllm_model.py:254-467)
+                self.waiting.popleft()
+                self.running.append(nxt)
+                kv_used += nxt.in_tokens
+
+    def _loop(self) -> None:
+        p = self.profile
+        while not self.stop_flag:
+            self._admit()
+            with self.lock:
+                batch = len(self.running)
+                new = [r for r in self.running if not r.prefilled]
+            if batch == 0:
+                time.sleep(0.0005)
+                continue
+            # one iteration: prefill for newly admitted + one decode step
+            step_ms = p.alpha + p.beta * batch
+            if new:
+                in_toks = max(r.in_tokens for r in new)
+                step_ms += p.gamma + p.delta * in_toks * batch
+            time.sleep(step_ms / 1000.0 * self.time_scale)
+            now = time.time()
+            finished: list[_Request] = []
+            with self.lock:
+                for r in self.running:
+                    if not r.prefilled:
+                        r.prefilled = True
+                        r.first_token_at = now
+                    r.tokens_done += 1
+                    if r.tokens_done >= r.out_tokens:
+                        r.finished_at = now
+                        finished.append(r)
+                for r in finished:
+                    self.running.remove(r)
+                    self.completions.append(
+                        (
+                            now,
+                            RequestResult(
+                                ttft_ms=(r.first_token_at - r.arrived) * 1000.0,
+                                latency_ms=(now - r.arrived) * 1000.0,
+                                in_tokens=r.in_tokens,
+                                out_tokens=r.out_tokens,
+                            ),
+                        )
+                    )
+            for r in finished:
+                r.done_event.set()

@@ -1,0 +1,85 @@
+"""Load generation against emulated (or real) engines.
+
+The analogue of /root/reference/tools/vllm-emulator/loadgen.py:38-131:
+Poisson or deterministic arrivals with a piecewise-constant rate
+schedule, driving fire-and-forget submissions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from inferno_tpu.emulator.engine import EmulatedEngine
+
+
+@dataclasses.dataclass(frozen=True)
+class RateSpec:
+    """Piecewise schedule: list of (duration_seconds, req_per_sec)."""
+
+    phases: tuple[tuple[float, float], ...] = ((10.0, 5.0),)
+
+    def rate_at(self, t: float) -> float:
+        acc = 0.0
+        for duration, rate in self.phases:
+            acc += duration
+            if t < acc:
+                return rate
+        return 0.0
+
+    @property
+    def total_duration(self) -> float:
+        return sum(d for d, _ in self.phases)
+
+
+class LoadGenerator:
+    def __init__(
+        self,
+        engines: list[EmulatedEngine],
+        rate: RateSpec,
+        in_tokens: int = 128,
+        out_tokens: int = 64,
+        poisson: bool = True,
+        seed: int = 0,
+    ):
+        self.engines = engines
+        self.rate = rate
+        self.in_tokens = in_tokens
+        self.out_tokens = out_tokens
+        self.poisson = poisson
+        self.rng = np.random.default_rng(seed)
+        self.submitted = 0
+        self._thread: threading.Thread | None = None
+
+    def _run(self) -> None:
+        start = time.time()
+        i = 0
+        while True:
+            t = time.time() - start
+            if t >= self.rate.total_duration:
+                return
+            rate = self.rate.rate_at(t)
+            if rate <= 0:
+                time.sleep(0.01)
+                continue
+            gap = (
+                float(self.rng.exponential(1.0 / rate)) if self.poisson else 1.0 / rate
+            )
+            time.sleep(gap)
+            # round-robin across replicas (a crude load balancer)
+            engine = self.engines[i % len(self.engines)]
+            i += 1
+            out = max(1, int(self.rng.poisson(self.out_tokens)))
+            engine.submit(self.in_tokens, out)
+            self.submitted += 1
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._thread:
+            self._thread.join(timeout)

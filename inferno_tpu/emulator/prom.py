@@ -1,0 +1,81 @@
+"""A PromClient that answers the collector's queries directly from
+emulated engines — the e2e stack without a Prometheus deployment.
+
+Query strings are matched by series name (the same vocabularies the real
+collector emits, inferno_tpu.controller.engines); rate()/ratio semantics are
+computed over a sliding window from the engines' event logs. Fleet-level
+aggregation (sum over replicas) falls out of summing over engines.
+"""
+
+from __future__ import annotations
+
+import time
+
+from inferno_tpu.controller.promclient import Sample
+from inferno_tpu.emulator.engine import EmulatedEngine
+
+WINDOW_SECONDS = 60.0
+
+
+class EmulatorProm:
+    def __init__(self, engines: dict[str, list[EmulatedEngine]] | None = None):
+        """engines: model_id -> replica engines."""
+        self.engines: dict[str, list[EmulatedEngine]] = engines or {}
+
+    def set_replicas(self, model: str, engines: list[EmulatedEngine]) -> None:
+        self.engines[model] = engines
+
+    def _model_from_query(self, promql: str) -> str | None:
+        for model in self.engines:
+            if f'"{model}"' in promql:
+                return model
+        return None
+
+    def _window(self, engines: list[EmulatedEngine]):
+        now = time.time()
+        cutoff = now - WINDOW_SECONDS
+        completions = [
+            (t, r) for e in engines for (t, r) in list(e.completions) if t >= cutoff
+        ]
+        # short-lived emulations: don't dilute rates over a window longer
+        # than the engines have existed
+        uptime = now - min(e.started_at for e in engines)
+        elapsed = max(min(WINDOW_SECONDS, uptime), 1e-3)
+        return now, completions, elapsed
+
+    def query(self, promql: str) -> list[Sample]:
+        model = self._model_from_query(promql)
+        if model is None:
+            return []
+        engines = self.engines.get(model, [])
+        if not engines:
+            return []
+        now, completions, elapsed = self._window(engines)
+
+        def sample(value: float) -> list[Sample]:
+            return [Sample(labels={"model_name": model}, value=value, timestamp=now)]
+
+        if "num_requests_running" in promql or "slots_used" in promql:
+            return sample(float(sum(e.num_running for e in engines)))
+        if "success" in promql:
+            return sample(len(completions) / elapsed)
+        if not completions:
+            return sample(0.0)
+        if "prompt_tokens" in promql or "input_length" in promql:
+            return sample(sum(r.in_tokens for _, r in completions) / len(completions))
+        if "generation_tokens" in promql or "output_length" in promql:
+            return sample(sum(r.out_tokens for _, r in completions) / len(completions))
+        if "first_token" in promql:
+            return sample(
+                sum(r.ttft_ms for _, r in completions) / len(completions) / 1000.0
+            )
+        if "per_output_token" in promql:
+            tpots = [
+                (r.latency_ms - r.ttft_ms) / max(r.out_tokens - 1, 1) / 1000.0
+                for _, r in completions
+            ]
+            return sample(sum(tpots) / len(tpots))
+        return []
+
+    def healthy(self) -> bool:
+        return True

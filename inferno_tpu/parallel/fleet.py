@@ -13,8 +13,6 @@ produces (reference: pkg/core/{server.go:55-67, allocation.go:27-163}).
 from __future__ import annotations
 
 import dataclasses
-import math
-
 import jax
 import numpy as np
 
@@ -42,17 +40,19 @@ class FleetPlan:
     """A flattened fleet batch plus the lane -> (server, acc) mapping."""
 
     params: FleetParams
-    lanes: list[tuple[str, str]]  # (server_name, acc_name) per live lane
-    k_max: int
-    num_lanes: int  # live lanes (before padding)
+    lanes: list[tuple[str, str]]  # (server_name, acc_name) per lane
+
+    @property
+    def num_lanes(self) -> int:
+        return len(self.lanes)
 
 
-def build_fleet(system: System, pad_to: int = 1) -> FleetPlan | None:
+def build_fleet(system: System) -> FleetPlan | None:
     """Flatten all loaded (server, slice-shape) pairs into a FleetParams.
 
     Zero-load servers are excluded (handled by the closed-form shortcut in
-    `calculate_fleet`). Lanes are padded with copies of lane 0 up to a
-    multiple of `pad_to` so the batch can shard evenly over a mesh.
+    `calculate_fleet`). Mesh padding happens per occupancy bucket in
+    `solve_fleet`, not here.
     """
     cols: dict[str, list] = {
         "alpha": [], "beta": [], "gamma": [], "delta": [],
@@ -64,7 +64,11 @@ def build_fleet(system: System, pad_to: int = 1) -> FleetPlan | None:
 
     for server_name, server in system.servers.items():
         load = server.load
+        # same eligibility guards as the scalar create_allocation
+        # (core/allocation.py): invalid loads produce no candidates
         if load is None or load.arrival_rate < 0:
+            continue
+        if load.avg_in_tokens < 0 or load.avg_out_tokens < 0:
             continue
         if load.arrival_rate == 0 or load.avg_out_tokens == 0:
             continue  # zero-load shortcut handled separately
@@ -78,6 +82,19 @@ def build_fleet(system: System, pad_to: int = 1) -> FleetPlan | None:
         for acc in server.candidate_accelerators(system).values():
             perf = model.perf_data.get(acc.name)
             if perf is None:
+                continue
+            # non-positive service time => the scalar analyzer raises and
+            # the pair is rejected; keep the batched path consistent
+            nd = load.avg_out_tokens - 1
+            if load.avg_in_tokens == 0 and load.avg_out_tokens == 1:
+                nd = 1
+            t1 = nd * (perf.decode_parms.alpha + perf.decode_parms.beta)
+            if load.avg_in_tokens > 0:
+                t1 += (
+                    perf.prefill_parms.gamma
+                    + perf.prefill_parms.delta * load.avg_in_tokens
+                )
+            if t1 <= 0:
                 continue
             k_out = load.avg_out_tokens
             if server.max_batch_size > 0:
@@ -105,15 +122,8 @@ def build_fleet(system: System, pad_to: int = 1) -> FleetPlan | None:
     if not lanes:
         return None
 
-    num_lanes = len(lanes)
-    padded = math.ceil(num_lanes / pad_to) * pad_to
-    pad = padded - num_lanes
-
     def col(name, dtype):
-        arr = np.asarray(cols[name], dtype=dtype)
-        if pad:
-            arr = np.concatenate([arr, np.repeat(arr[:1], pad, axis=0)])
-        return arr
+        return np.asarray(cols[name], dtype=dtype)
 
     params = FleetParams(
         alpha=col("alpha", np.float32),
@@ -131,9 +141,7 @@ def build_fleet(system: System, pad_to: int = 1) -> FleetPlan | None:
         min_replicas=col("min_replicas", np.int32),
         cost_per_replica=col("cost_per_replica", np.float32),
     )
-    k_raw = int(np.max(params.occupancy_cap))
-    k_max = max(_K_PAD, math.ceil(k_raw / _K_PAD) * _K_PAD)
-    return FleetPlan(params=params, lanes=lanes, k_max=k_max, num_lanes=num_lanes)
+    return FleetPlan(params=params, lanes=lanes)
 
 
 _fn_cache: dict[tuple[int, int], object] = {}
@@ -229,7 +237,6 @@ def calculate_fleet(
     """
     if use_mesh and mesh is None:
         mesh = fleet_mesh()
-    pad_to = mesh.size if mesh is not None else 1
 
     for server in system.servers.values():
         server.all_allocations = {}
@@ -253,7 +260,8 @@ def calculate_fleet(
             alloc.value = transition_penalty(server.cur_allocation, alloc)
             server.all_allocations[acc.name] = alloc
 
-    plan = build_fleet(system, pad_to=pad_to)
+    plan = build_fleet(system)
+    system.candidates_calculated = True
     if plan is None:
         return 0
     result = solve_fleet(plan, mesh=mesh)

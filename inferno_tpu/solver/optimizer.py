@@ -45,18 +45,15 @@ class Optimizer:
         re-size; False skips it.
         """
         t0 = time.perf_counter()
-        if calculate:
+        if calculate or (calculate is None and not system.candidates_calculated):
+            # auto (None): size only if nobody has sized this system yet, so
+            # a system prepared by calculate_fleet (the TPU path) is not
+            # silently re-sized by the scalar loop — including servers the
+            # fleet path found infeasible. A System is a per-cycle value
+            # (the controller rebuilds it each reconcile, like the
+            # reference); mutating loads between optimize() calls requires
+            # calculate=True.
             system.calculate_all()
-        elif calculate is None:
-            # auto: size any server that has no candidates yet, so a system
-            # prepared by calculate_fleet (the TPU path) is not re-sized by
-            # the scalar path, while servers added afterwards still get
-            # candidates. A System is a per-cycle value (the controller
-            # rebuilds it each reconcile, like the reference); mutating
-            # loads between optimize() calls requires calculate=True.
-            for server in system.servers.values():
-                if not server.all_allocations:
-                    server.calculate(system)
         t1 = time.perf_counter()
         self.solver.solve(system)
         self.solution_time_msec = (time.perf_counter() - t1) * 1000.0

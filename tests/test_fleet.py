@@ -108,13 +108,24 @@ def test_fleet_sharded_over_mesh_matches_unsharded():
             )
 
 
-def test_build_fleet_pads_lanes():
+def test_build_fleet_lanes():
     spec = _spec_multi()
     system = System(spec)
-    plan = build_fleet(system, pad_to=8)
+    plan = build_fleet(system)
     assert plan.num_lanes == 9  # 3 servers x 3 shapes
-    assert plan.params.alpha.shape[0] == 16  # padded to multiple of 8
-    assert plan.k_max % 128 == 0
+    assert plan.params.alpha.shape[0] == 9  # mesh padding is per-bucket
+
+
+def test_fleet_invalid_load_excluded():
+    # negative token counts: scalar create_allocation returns None; the
+    # batched path must also produce no candidates
+    srv = make_server()
+    srv.current_alloc.load.avg_in_tokens = -5
+    spec = make_system_spec([srv])
+    fleet = _fleet_system(spec)
+    assert fleet.servers[srv.name].all_allocations == {}
+    scalar = _scalar_system(spec)
+    assert scalar.servers[srv.name].all_allocations == {}
 
 
 def test_fleet_end_to_end_with_solver():

@@ -1,13 +1,14 @@
 """The driver contract: entry() compiles single-chip; dryrun_multichip
 compiles and executes the sharded training + fleet programs."""
 
+import pathlib
 import sys
 
 import jax
 import numpy as np
 import pytest
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 import __graft_entry__ as graft
 
