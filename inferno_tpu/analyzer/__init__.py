@@ -11,9 +11,17 @@ from inferno_tpu.analyzer.queue import (
     service_rates,
     solve_birth_death,
 )
+from inferno_tpu.analyzer.disagg import (
+    DisaggAnalyzer,
+    DisaggSpec,
+    build_disagg_analyzer,
+)
 from inferno_tpu.analyzer.sizing import BisectionResult, bisect_monotone
 
 __all__ = [
+    "DisaggAnalyzer",
+    "DisaggSpec",
+    "build_disagg_analyzer",
     "AnalysisMetrics",
     "AnalyzerError",
     "QueueAnalyzer",

@@ -106,6 +106,55 @@ class PrefillParms:
     delta: float = 0.0
 
 
+@dataclasses.dataclass(frozen=True)
+class DisaggSpec:
+    """Shape of one disaggregated (JetStream-style) replica unit: separate
+    prefill and decode engines scheduled as an atomic group.
+
+    `prefill_slices` / `decode_slices`: engines of each role per unit. Each
+    engine occupies `ModelPerfSpec.slices_per_replica` pod-slices, so the
+    unit's total slice footprint is
+    slices_per_replica * (prefill_slices + decode_slices).
+    `prefill_max_batch`: concurrent prompts per prefill engine (JetStream
+    typically runs few, large prefill batches; 0 = same as decode batch).
+    """
+
+    prefill_slices: int = 1
+    decode_slices: int = 1
+    prefill_max_batch: int = 0
+
+    def validate(self) -> None:
+        if self.prefill_slices < 1 or self.decode_slices < 1:
+            raise ValueError(f"invalid disagg spec {self}")
+        if self.prefill_max_batch < 0:
+            raise ValueError(f"invalid disagg spec {self}")
+
+    @property
+    def slices_per_unit(self) -> int:
+        return self.prefill_slices + self.decode_slices
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "prefillSlices": self.prefill_slices,
+            "decodeSlices": self.decode_slices,
+            "prefillMaxBatch": self.prefill_max_batch,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "DisaggSpec":
+        def _int(key: str, default: int) -> int:
+            v = d.get(key)
+            # missing/null -> default; an explicit invalid value (e.g. 0
+            # engines) is preserved so validate() rejects it downstream
+            return default if v is None else int(v)
+
+        return cls(
+            prefill_slices=_int("prefillSlices", 1),
+            decode_slices=_int("decodeSlices", 1),
+            prefill_max_batch=_int("prefillMaxBatch", 0),
+        )
+
+
 @dataclasses.dataclass
 class ModelPerfSpec:
     """Performance profile of one model on one slice shape
@@ -123,9 +172,13 @@ class ModelPerfSpec:
     at_tokens: int = 0  # avg tokens/request assumed for max_batch_size
     decode_parms: DecodeParms = dataclasses.field(default_factory=DecodeParms)
     prefill_parms: PrefillParms = dataclasses.field(default_factory=PrefillParms)
+    # Set for disaggregated (JetStream-style) serving: one replica is then a
+    # unit of prefill_slices + decode_slices pod-slices of this shape, sized
+    # by the tandem model in inferno_tpu.analyzer.disagg.
+    disagg: DisaggSpec | None = None
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "name": self.name,
             "acc": self.acc,
             "slicesPerReplica": self.slices_per_replica,
@@ -134,11 +187,15 @@ class ModelPerfSpec:
             "decodeParms": {"alpha": self.decode_parms.alpha, "beta": self.decode_parms.beta},
             "prefillParms": {"gamma": self.prefill_parms.gamma, "delta": self.prefill_parms.delta},
         }
+        if self.disagg is not None:
+            out["disagg"] = self.disagg.to_dict()
+        return out
 
     @classmethod
     def from_dict(cls, d: Mapping[str, Any]) -> "ModelPerfSpec":
         dp = _get(d, "decodeParms", default={}) or {}
         pp = _get(d, "prefillParms", default={}) or {}
+        dg = _get(d, "disagg", default=None)
         return cls(
             name=d["name"],
             acc=d["acc"],
@@ -147,6 +204,7 @@ class ModelPerfSpec:
             at_tokens=int(_get(d, "atTokens", default=0) or 0),
             decode_parms=DecodeParms(float(dp.get("alpha", 0.0)), float(dp.get("beta", 0.0))),
             prefill_parms=PrefillParms(float(pp.get("gamma", 0.0)), float(pp.get("delta", 0.0))),
+            disagg=DisaggSpec.from_dict(dg) if dg else None,
         )
 
 

@@ -18,7 +18,13 @@ import dataclasses
 import math
 from typing import TYPE_CHECKING
 
-from inferno_tpu.analyzer import AnalyzerError, RequestSize, TargetPerf, build_analyzer
+from inferno_tpu.analyzer import (
+    AnalyzerError,
+    RequestSize,
+    TargetPerf,
+    build_analyzer,
+    build_disagg_analyzer,
+)
 from inferno_tpu.config.defaults import ACCEL_PENALTY_FACTOR, MAX_QUEUE_TO_BATCH_RATIO
 from inferno_tpu.config.types import AllocationData
 
@@ -115,14 +121,27 @@ def create_allocation(system: "System", server_name: str, acc_name: str) -> Allo
         batch = max(perf.max_batch_size * perf.at_tokens // k_out, 1)
     max_queue = batch * MAX_QUEUE_TO_BATCH_RATIO
 
+    request = RequestSize(avg_in_tokens=load.avg_in_tokens, avg_out_tokens=k_out)
     try:
-        qa = build_analyzer(
-            max_batch=batch,
-            max_queue=max_queue,
-            decode=perf.decode_parms,
-            prefill=perf.prefill_parms,
-            request=RequestSize(avg_in_tokens=load.avg_in_tokens, avg_out_tokens=k_out),
-        )
+        if perf.disagg is not None:
+            # JetStream-style disaggregated serving: one replica is an atomic
+            # prefill+decode unit, sized by the tandem model.
+            qa = build_disagg_analyzer(
+                max_batch=batch,
+                max_queue=max_queue,
+                decode=perf.decode_parms,
+                prefill=perf.prefill_parms,
+                request=request,
+                spec=perf.disagg,
+            )
+        else:
+            qa = build_analyzer(
+                max_batch=batch,
+                max_queue=max_queue,
+                decode=perf.decode_parms,
+                prefill=perf.prefill_parms,
+                request=request,
+            )
         _, metrics, _ = qa.size(
             TargetPerf(
                 target_ttft=target.slo_ttft,

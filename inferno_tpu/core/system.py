@@ -75,9 +75,14 @@ class Model:
 
     def slices_per_replica(self, acc_name: str) -> int:
         """Slice units one replica occupies (reference numInstances,
-        pkg/core/model.go:45-54)."""
+        pkg/core/model.go:45-54). For disaggregated serving a replica is
+        the atomic prefill+decode unit, so its slice footprint multiplies
+        by the unit size."""
         perf = self.perf_data.get(acc_name)
-        return perf.slices_per_replica if perf else 1
+        if perf is None:
+            return 1
+        units = perf.disagg.slices_per_unit if perf.disagg else 1
+        return perf.slices_per_replica * units
 
 
 class ServiceClass:

@@ -19,6 +19,7 @@ import numpy as np
 from inferno_tpu.core.allocation import (
     Allocation,
     _zero_load_allocation,
+    create_allocation,
     transition_penalty,
 )
 from inferno_tpu.core.system import System
@@ -83,6 +84,8 @@ def build_fleet(system: System) -> FleetPlan | None:
             perf = model.perf_data.get(acc.name)
             if perf is None:
                 continue
+            if perf.disagg is not None:
+                continue  # tandem model lanes go through the scalar fallback
             # non-positive service time => the scalar analyzer raises and
             # the pair is rejected; keep the batched path consistent
             nd = load.avg_out_tokens - 1
@@ -260,10 +263,30 @@ def calculate_fleet(
             alloc.value = transition_penalty(server.cur_allocation, alloc)
             server.all_allocations[acc.name] = alloc
 
+    # disaggregated (prefill/decode tandem) lanes: the batched kernel models
+    # a single mu(n) stage, so these size through the scalar tandem analyzer
+    n_disagg = 0
+    for server_name, server in system.servers.items():
+        load = server.load
+        if load is None or load.arrival_rate <= 0 or load.avg_out_tokens == 0:
+            continue
+        model = system.models.get(server.model_name)
+        if model is None:
+            continue
+        for acc in server.candidate_accelerators(system).values():
+            perf = model.perf_data.get(acc.name)
+            if perf is None or perf.disagg is None:
+                continue
+            alloc = create_allocation(system, server_name, acc.name)
+            if alloc is not None:
+                alloc.value = transition_penalty(server.cur_allocation, alloc)
+                server.all_allocations[acc.name] = alloc
+                n_disagg += 1
+
     plan = build_fleet(system)
     system.candidates_calculated = True
     if plan is None:
-        return 0
+        return n_disagg
     result = solve_fleet(plan, mesh=mesh)
 
     for i, (server_name, acc_name) in enumerate(plan.lanes):
@@ -282,4 +305,4 @@ def calculate_fleet(
         )
         alloc.value = transition_penalty(server.cur_allocation, alloc)
         server.all_allocations[acc_name] = alloc
-    return plan.num_lanes
+    return plan.num_lanes + n_disagg
