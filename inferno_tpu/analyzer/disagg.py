@@ -53,10 +53,9 @@ from inferno_tpu.analyzer.queue import (
     RATE_EPSILON,
     decode_time,
     prefill_time,
+    size_with_targets,
     solve_birth_death,
 )
-from inferno_tpu.analyzer.sizing import bisect_monotone
-from inferno_tpu.config.defaults import STABILITY_SAFETY_FRACTION
 from inferno_tpu.config.types import DecodeParms, DisaggSpec, PrefillParms
 
 
@@ -213,48 +212,9 @@ class DisaggAnalyzer:
         )
 
     def size(self, targets: TargetPerf) -> tuple[TargetRate, AnalysisMetrics, TargetPerf]:
-        """Max unit request rates meeting each SLO target; mirrors
-        `QueueAnalyzer.size` semantics."""
-        targets.validate()
-        lam_min, lam_max = self.lambda_min, self.lambda_max
-
-        lam_ttft = lam_max
-        if targets.target_ttft > 0:
-            res = bisect_monotone(lam_min, lam_max, targets.target_ttft, self._ttft_at)
-            if res.indicator < 0:
-                raise AnalyzerError(
-                    f"TTFT target {targets.target_ttft} ms unachievable: "
-                    f"below value at minimum rate"
-                )
-            lam_ttft = res.x
-
-        lam_itl = lam_max
-        if targets.target_itl > 0:
-            res = bisect_monotone(lam_min, lam_max, targets.target_itl, self._itl_at)
-            if res.indicator < 0:
-                raise AnalyzerError(
-                    f"ITL target {targets.target_itl} ms unachievable: "
-                    f"below value at minimum rate"
-                )
-            lam_itl = res.x
-
-        lam_tps = lam_max
-        if targets.target_tps > 0:
-            lam_tps = lam_max * (1.0 - STABILITY_SAFETY_FRACTION)
-
-        lam_star = min(lam_ttft, lam_itl, lam_tps)
-        metrics = self.analyze(lam_star * 1000.0)
-        achieved = TargetPerf(
-            target_ttft=metrics.avg_wait_time + metrics.avg_prefill_time,
-            target_itl=metrics.avg_token_time,
-            target_tps=metrics.throughput * self.request.avg_out_tokens,
-        )
-        rates = TargetRate(
-            rate_target_ttft=lam_ttft * 1000.0,
-            rate_target_itl=lam_itl * 1000.0,
-            rate_target_tps=lam_tps * 1000.0,
-        )
-        return rates, metrics, achieved
+        """Max unit request rates meeting each SLO target; shares the
+        sizing driver with `QueueAnalyzer.size`."""
+        return size_with_targets(self, targets)
 
 
 def build_disagg_analyzer(

@@ -228,6 +228,56 @@ def effective_concurrency(
     return float(np.clip(numerator / denominator, 0.0, float(max_batch)))
 
 
+def size_with_targets(
+    analyzer, targets: TargetPerf
+) -> tuple[TargetRate, AnalysisMetrics, TargetPerf]:
+    """Shared sizing driver for any analyzer exposing lambda_min/lambda_max,
+    _ttft_at, _itl_at, analyze, and a request (QueueAnalyzer and
+    DisaggAnalyzer): bisect the max rate for each active target, cap TPS by
+    the stability headroom, evaluate at the binding minimum
+    (reference: pkg/analyzer/queueanalyzer.go:185-255)."""
+    targets.validate()
+    lam_min, lam_max = analyzer.lambda_min, analyzer.lambda_max
+
+    lam_ttft = lam_max
+    if targets.target_ttft > 0:
+        res = bisect_monotone(lam_min, lam_max, targets.target_ttft, analyzer._ttft_at)
+        if res.indicator < 0:
+            raise AnalyzerError(
+                f"TTFT target {targets.target_ttft} ms unachievable: "
+                f"below value at minimum rate"
+            )
+        lam_ttft = res.x
+
+    lam_itl = lam_max
+    if targets.target_itl > 0:
+        res = bisect_monotone(lam_min, lam_max, targets.target_itl, analyzer._itl_at)
+        if res.indicator < 0:
+            raise AnalyzerError(
+                f"ITL target {targets.target_itl} ms unachievable: "
+                f"below value at minimum rate"
+            )
+        lam_itl = res.x
+
+    lam_tps = lam_max
+    if targets.target_tps > 0:
+        lam_tps = lam_max * (1.0 - STABILITY_SAFETY_FRACTION)
+
+    lam_star = min(lam_ttft, lam_itl, lam_tps)
+    metrics = analyzer.analyze(lam_star * 1000.0)
+    achieved = TargetPerf(
+        target_ttft=metrics.avg_wait_time + metrics.avg_prefill_time,
+        target_itl=metrics.avg_token_time,
+        target_tps=metrics.throughput * analyzer.request.avg_out_tokens,
+    )
+    rates = TargetRate(
+        rate_target_ttft=lam_ttft * 1000.0,
+        rate_target_itl=lam_itl * 1000.0,
+        rate_target_tps=lam_tps * 1000.0,
+    )
+    return rates, metrics, achieved
+
+
 @dataclasses.dataclass(frozen=True)
 class QueueAnalyzer:
     """Immutable analyzer for one (server, slice-shape) configuration
@@ -305,46 +355,7 @@ class QueueAnalyzer:
         Raises AnalyzerError when a target is unachievable even at the
         lowest stable rate.
         """
-        targets.validate()
-        lam_min, lam_max = self.lambda_min, self.lambda_max
-
-        lam_ttft = lam_max
-        if targets.target_ttft > 0:
-            res = bisect_monotone(lam_min, lam_max, targets.target_ttft, self._ttft_at)
-            if res.indicator < 0:
-                raise AnalyzerError(
-                    f"TTFT target {targets.target_ttft} ms unachievable: "
-                    f"below value at minimum rate"
-                )
-            lam_ttft = res.x
-
-        lam_itl = lam_max
-        if targets.target_itl > 0:
-            res = bisect_monotone(lam_min, lam_max, targets.target_itl, self._itl_at)
-            if res.indicator < 0:
-                raise AnalyzerError(
-                    f"ITL target {targets.target_itl} ms unachievable: "
-                    f"below value at minimum rate"
-                )
-            lam_itl = res.x
-
-        lam_tps = lam_max
-        if targets.target_tps > 0:
-            lam_tps = lam_max * (1.0 - STABILITY_SAFETY_FRACTION)
-
-        lam_star = min(lam_ttft, lam_itl, lam_tps)
-        metrics = self.analyze(lam_star * 1000.0)
-        achieved = TargetPerf(
-            target_ttft=metrics.avg_wait_time + metrics.avg_prefill_time,
-            target_itl=metrics.avg_token_time,
-            target_tps=metrics.throughput * self.request.avg_out_tokens,
-        )
-        rates = TargetRate(
-            rate_target_ttft=lam_ttft * 1000.0,
-            rate_target_itl=lam_itl * 1000.0,
-            rate_target_tps=lam_tps * 1000.0,
-        )
-        return rates, metrics, achieved
+        return size_with_targets(self, targets)
 
 
 def build_analyzer(

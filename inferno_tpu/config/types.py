@@ -204,7 +204,8 @@ class ModelPerfSpec:
             at_tokens=int(_get(d, "atTokens", default=0) or 0),
             decode_parms=DecodeParms(float(dp.get("alpha", 0.0)), float(dp.get("beta", 0.0))),
             prefill_parms=PrefillParms(float(pp.get("gamma", 0.0)), float(pp.get("delta", 0.0))),
-            disagg=DisaggSpec.from_dict(dg) if dg else None,
+            # `{}` is a valid spec (all defaults); only absent/null disables
+            disagg=DisaggSpec.from_dict(dg) if dg is not None else None,
         )
 
 

@@ -18,7 +18,8 @@ controller.go:516-582):
   CONFIG_NAMESPACE              (default inferno-system)
   SERVING_ENGINE                vllm-tpu | jetstream
   METRICS_PORT                  (default 8443)
-  USE_TPU_FLEET                 true|false (default true)
+  COMPUTE_BACKEND               tpu | native | scalar (default tpu;
+                                USE_TPU_FLEET=false maps to scalar)
   DIRECT_SCALE                  true|false (default false; HPA otherwise)
 """
 
@@ -86,7 +87,9 @@ def main() -> int:
         config_namespace=os.environ.get("CONFIG_NAMESPACE", "inferno-system"),
         engine=os.environ.get("SERVING_ENGINE", "vllm-tpu"),
         scale_to_zero=env_bool("WVA_SCALE_TO_ZERO"),
-        use_tpu_fleet=env_bool("USE_TPU_FLEET", True),
+        compute_backend=os.environ.get(
+            "COMPUTE_BACKEND", "tpu" if env_bool("USE_TPU_FLEET", True) else "scalar"
+        ).lower(),
         direct_scale=env_bool("DIRECT_SCALE"),
     )
     rec = Reconciler(kube=kube, prom=prom, config=config, emitter=emitter)

@@ -68,7 +68,16 @@ class ReconcilerConfig:
     config_namespace: str = "inferno-system"
     engine: str = "vllm-tpu"  # serving engine metric vocabulary
     scale_to_zero: bool = False  # reference env WVA_SCALE_TO_ZERO (utils.go:282-285)
-    use_tpu_fleet: bool = True  # batched sizing vs scalar loop
+    # candidate-sizing backend: "tpu" (batched XLA kernel), "native" (C++
+    # solver, no TPU attachment needed), or "scalar" (pure-Python loop)
+    compute_backend: str = "tpu"
+
+    def __post_init__(self) -> None:
+        if self.compute_backend not in ("tpu", "native", "scalar"):
+            raise ValueError(
+                f"compute_backend must be tpu|native|scalar, "
+                f"got {self.compute_backend!r}"
+            )
     direct_scale: bool = False  # actuate Deployments directly (no HPA)
     interval_seconds: int = DEFAULT_INTERVAL_SECONDS
 
@@ -363,10 +372,10 @@ class Reconciler:
         system = System(spec)
         t0 = time.perf_counter()
         try:
-            if self.config.use_tpu_fleet:
+            if self.config.compute_backend in ("tpu", "native"):
                 from inferno_tpu.parallel import calculate_fleet
 
-                calculate_fleet(system)
+                calculate_fleet(system, backend=self.config.compute_backend)
             else:
                 system.calculate_all()
             report.analysis_ms = (time.perf_counter() - t0) * 1000.0

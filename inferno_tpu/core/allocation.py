@@ -199,7 +199,18 @@ def _zero_load_allocation(server, model, acc, perf) -> Allocation:
     decode_1 = perf.decode_parms.alpha + perf.decode_parms.beta
     decode_full = perf.decode_parms.alpha + perf.decode_parms.beta * batch
     prefill_1 = perf.prefill_parms.gamma + perf.prefill_parms.delta
-    max_serv_time = prefill_1 + decode_full
+    if perf.disagg is not None:
+        # disaggregated unit: the binding stage caps the unit's rate (same
+        # one-token-per-stage convention as the aggregated bound below)
+        dg = perf.disagg
+        p_batch = dg.prefill_max_batch or batch
+        prefill_full = perf.prefill_parms.gamma + perf.prefill_parms.delta * p_batch
+        max_rate = min(
+            dg.prefill_slices * p_batch / prefill_full,
+            dg.decode_slices * batch / decode_full,
+        )
+    else:
+        max_rate = batch / (prefill_1 + decode_full)
     alloc = Allocation(
         accelerator=acc.name,
         num_replicas=num_replicas,
@@ -208,7 +219,7 @@ def _zero_load_allocation(server, model, acc, perf) -> Allocation:
         itl=decode_1,
         ttft=prefill_1,
         rho=0.0,
-        max_arrv_rate_per_replica=batch / max_serv_time,
+        max_arrv_rate_per_replica=max_rate,
     )
     alloc.value = alloc.cost
     return alloc

@@ -1,0 +1,163 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The queueing solve + SLO sizing has a C++ implementation
+(`queueing.cc`) for controller deployments without a TPU attachment —
+the TPU-batched kernel (inferno_tpu.ops.queueing) stays the flagship
+path. The shared library is built on demand with the system toolchain
+(g++ is part of the image; there is no pybind11 here by design — the
+ABI is plain C consumed through ctypes, so the extension has zero
+Python build-time dependencies).
+
+`available()` reports whether the library could be built/loaded;
+callers fall back to the scalar analyzer when it is not.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import NamedTuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "queueing.cc")
+_LIB = os.path.join(_DIR, "libinferno_queueing.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_error: str | None = None
+
+_D = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_I = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_U8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+DEFAULT_BISECT_ITERS = 64  # double precision; deeper than the f32 TPU kernel
+
+
+def _build() -> None:
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-o",
+        _LIB,
+        _SRC,
+        "-pthread",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None or _load_error is not None:
+            return _lib
+        try:
+            stale = (
+                not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            )
+            if stale:
+                _build()
+            lib = ctypes.CDLL(_LIB)
+            fn = lib.inferno_fleet_size
+            fn.restype = ctypes.c_int
+            fn.argtypes = [
+                ctypes.c_int32,  # n_lanes
+                _D, _D, _D, _D,  # alpha beta gamma delta
+                _D, _D,  # in_tokens out_tokens
+                _I, _I,  # max_batch occupancy_cap
+                _D, _D, _D,  # targets ttft itl tps
+                _D, _I, _D,  # total_rate min_replicas cost_per_replica
+                ctypes.c_int32,  # n_iters
+                ctypes.c_int32,  # n_threads
+                _U8, _D, _D, _I, _D, _D, _D, _D,  # outputs
+            ]
+            _lib = lib
+        except (OSError, subprocess.CalledProcessError) as e:
+            _load_error = str(e)
+    return _lib
+
+
+def available() -> bool:
+    """Whether the native library can be (built and) loaded."""
+    return _load() is not None
+
+
+def load_error() -> str | None:
+    return _load_error
+
+
+class NativeFleetResult(NamedTuple):
+    """Mirrors ops.queueing.FleetResult (numpy, float64)."""
+
+    feasible: np.ndarray
+    lambda_star: np.ndarray
+    rate_star: np.ndarray
+    num_replicas: np.ndarray
+    cost: np.ndarray
+    itl: np.ndarray
+    ttft: np.ndarray
+    rho: np.ndarray
+
+
+def fleet_size_native(
+    params, n_iters: int = DEFAULT_BISECT_ITERS, n_threads: int = 0
+) -> NativeFleetResult:
+    """Size every lane of a FleetParams batch with the C++ solver.
+
+    `params` is any structure with the FleetParams fields (numpy or jax
+    arrays). Semantics match ops.queueing.fleet_size; precision is f64.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_error}")
+
+    def d(a):
+        return np.ascontiguousarray(np.asarray(a), dtype=np.float64)
+
+    def i(a):
+        return np.ascontiguousarray(np.asarray(a), dtype=np.int32)
+
+    alpha = d(params.alpha)
+    n = alpha.shape[0]
+    if n_threads <= 0:
+        n_threads = os.cpu_count() or 1
+    out = NativeFleetResult(
+        feasible=np.zeros(n, np.uint8),
+        lambda_star=np.zeros(n, np.float64),
+        rate_star=np.zeros(n, np.float64),
+        num_replicas=np.zeros(n, np.int32),
+        cost=np.zeros(n, np.float64),
+        itl=np.zeros(n, np.float64),
+        ttft=np.zeros(n, np.float64),
+        rho=np.zeros(n, np.float64),
+    )
+    rc = lib.inferno_fleet_size(
+        n,
+        alpha, d(params.beta), d(params.gamma), d(params.delta),
+        d(params.in_tokens), d(params.out_tokens),
+        i(params.max_batch), i(params.occupancy_cap),
+        d(params.target_ttft), d(params.target_itl), d(params.target_tps),
+        d(params.total_rate), i(params.min_replicas), d(params.cost_per_replica),
+        n_iters, n_threads,
+        out.feasible, out.lambda_star, out.rate_star, out.num_replicas,
+        out.cost, out.itl, out.ttft, out.rho,
+    )
+    if rc != 0:
+        raise RuntimeError(f"inferno_fleet_size failed with code {rc}")
+    return out._replace(feasible=out.feasible.astype(bool))
+
+
+__all__ = [
+    "DEFAULT_BISECT_ITERS",
+    "NativeFleetResult",
+    "available",
+    "fleet_size_native",
+    "load_error",
+]

@@ -1,0 +1,279 @@
+// Native (C++) fleet sizing: the CPU fast path of the queueing solve.
+//
+// Semantics are defined by the Python scalar analyzer
+// (inferno_tpu/analyzer/queue.py) and mirrored by the batched JAX kernel
+// (inferno_tpu/ops/queueing.py); this file implements the same math in
+// double precision for deployments where the controller runs without a
+// TPU attachment (the reference's solver is likewise ordinary CPU code,
+// /root/reference/pkg/analyzer/mm1modelstatedependent.go:70-116 and
+// pkg/core/allocation.go:27-163).
+//
+// Per lane (one (server, slice-shape) pair):
+//   mu(n)   = n / (prefill(n) + num_decodes * decode(n)),  n = 1..B
+//   logp[k] = k*log(lam) - cumsum(log mu)  (stationary dist, log-space)
+//   sizing  = bisection over lam for the TTFT and ITL targets, TPS cap,
+//             then replicas = ceil(total_rate / rate*) and the expected
+//             per-replica operating point.
+//
+// Exposed as a C ABI consumed via ctypes (inferno_tpu/native/__init__.py).
+// Lanes are independent; an optional thread pool splits them.
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr double kRateEps = 1e-3;            // analyzer.queue.RATE_EPSILON
+constexpr double kStabilitySafety = 0.1;     // defaults.STABILITY_SAFETY_FRACTION
+constexpr double kFeasSlack = 1e-6;          // ops.queueing feasibility slack
+
+struct Lane {
+  double alpha, beta, gamma, delta;
+  double in_tokens, out_tokens;
+  int32_t max_batch, occupancy_cap;
+  double target_ttft, target_itl, target_tps;
+  double total_rate;  // req/sec
+  int32_t min_replicas;
+  double cost_per_replica;
+};
+
+struct Stats {
+  double wait, serv, in_servers, tput;
+};
+
+struct Grid {
+  // cml[k-1] = sum_{j<=k} log mu(j), k = 1..K
+  std::vector<double> cml;
+  int32_t K;  // occupancy cap
+  int32_t B;  // max batch
+};
+
+double num_decodes(const Lane& ln) {
+  // analyzer.queue.service_rates: single-token decode-only requests still
+  // pay one decode step
+  if (ln.in_tokens == 0.0 && ln.out_tokens == 1.0) return 1.0;
+  return ln.out_tokens - 1.0;
+}
+
+double service_time(const Lane& ln, double n) {
+  double prefill =
+      ln.in_tokens > 0.0 ? ln.gamma + ln.delta * ln.in_tokens * n : 0.0;
+  return prefill + num_decodes(ln) * (ln.alpha + ln.beta * n);
+}
+
+double service_rate(const Lane& ln, double n) { return n / service_time(ln, n); }
+
+Grid make_grid(const Lane& ln) {
+  Grid g;
+  g.B = ln.max_batch;
+  g.K = ln.occupancy_cap;
+  g.cml.resize(g.K);
+  double acc = 0.0;
+  for (int32_t k = 1; k <= g.K; ++k) {
+    double n_eff = std::min<double>(k, g.B);
+    acc += std::log(n_eff) - std::log(service_time(ln, n_eff));
+    g.cml[k - 1] = acc;
+  }
+  return g;
+}
+
+Stats solve_stats(double lam, const Grid& g) {
+  // logp[0] = 0, logp[k] = k*log(lam) - cml[k-1]
+  const double loglam = std::log(lam);
+  double m = 0.0;  // max over logp (logp[0] = 0 included)
+  for (int32_t k = 1; k <= g.K; ++k)
+    m = std::max(m, k * loglam - g.cml[k - 1]);
+
+  double z = std::exp(-m);          // state 0
+  double sum_k = 0.0;               // sum k * w
+  double mass_le_b = std::exp(-m);  // states k <= B
+  double sum_k_le_b = 0.0;
+  double w_cap = 0.0;               // state K
+  for (int32_t k = 1; k <= g.K; ++k) {
+    double w = std::exp(k * loglam - g.cml[k - 1] - m);
+    z += w;
+    sum_k += k * w;
+    if (k <= g.B) {
+      mass_le_b += w;
+      sum_k_le_b += k * w;
+    }
+    if (k == g.K) w_cap = w;
+  }
+  const double in_system = sum_k / z;
+  const double in_servers = sum_k_le_b / z + g.B * (1.0 - mass_le_b / z);
+  const double p_block = w_cap / z;
+  const double tput = lam * (1.0 - p_block);
+  const double resp = in_system / tput;
+  const double serv = in_servers / tput;
+  Stats s;
+  s.wait = std::max(resp - serv, 0.0);
+  s.serv = serv;
+  s.in_servers = in_servers;
+  s.tput = tput;
+  return s;
+}
+
+double concurrency(const Lane& ln, double serv) {
+  // analyzer.queue.effective_concurrency
+  const double tokens = ln.out_tokens - 1.0;
+  const double numer = serv - (ln.gamma + ln.alpha * tokens);
+  const double denom = ln.delta * ln.in_tokens + ln.beta * tokens;
+  const double nmax = ln.max_batch;
+  if (denom <= 0.0) return numer > 0.0 ? nmax : 0.0;
+  return std::clamp(numer / denom, 0.0, nmax);
+}
+
+void ttft_itl_at(double lam, const Lane& ln, const Grid& g, double* ttft,
+                 double* itl) {
+  Stats s = solve_stats(lam, g);
+  double conc = concurrency(ln, s.serv);
+  double prefill =
+      ln.in_tokens > 0.0 ? ln.gamma + ln.delta * ln.in_tokens * conc : 0.0;
+  *ttft = s.wait + prefill;
+  *itl = ln.alpha + ln.beta * conc;
+}
+
+// Bisection for an increasing metric-of-rate; mirrors
+// ops.queueing._bisect_increasing (reference indicator semantics at
+// pkg/analyzer/utils.go:44-50).
+void bisect(const Lane& ln, const Grid& g, double lam_min, double lam_max,
+            double target, double y_lo, double y_hi, bool use_itl,
+            int32_t n_iters, double* lam_out, bool* ok_out) {
+  const bool feasible = target >= y_lo * (1.0 - kFeasSlack);
+  if (target >= y_hi) {
+    *lam_out = lam_max;
+    *ok_out = feasible;
+    return;
+  }
+  double lo = lam_min, hi = lam_max;
+  for (int32_t i = 0; i < n_iters; ++i) {
+    const double mid = 0.5 * (lo + hi);
+    double ttft, itl;
+    ttft_itl_at(mid, ln, g, &ttft, &itl);
+    const double y = use_itl ? itl : ttft;
+    if (y > target)
+      hi = mid;
+    else
+      lo = mid;
+  }
+  *lam_out = feasible ? 0.5 * (lo + hi) : lam_min;
+  *ok_out = feasible;
+}
+
+void size_lane(const Lane& ln, int32_t n_iters, uint8_t* feasible,
+               double* lambda_star, double* rate_star, int32_t* num_replicas,
+               double* cost, double* itl_out, double* ttft_out, double* rho) {
+  const Grid g = make_grid(ln);
+  const double lam_min = service_rate(ln, 1.0) * kRateEps;
+  const double lam_max = service_rate(ln, ln.max_batch) * (1.0 - kRateEps);
+
+  double ttft_lo, itl_lo, ttft_hi, itl_hi;
+  ttft_itl_at(lam_min, ln, g, &ttft_lo, &itl_lo);
+  ttft_itl_at(lam_max, ln, g, &ttft_hi, &itl_hi);
+
+  double lam_ttft = lam_max, lam_itl = lam_max;
+  bool ok_ttft = true, ok_itl = true;
+  if (ln.target_ttft > 0.0)
+    bisect(ln, g, lam_min, lam_max, ln.target_ttft, ttft_lo, ttft_hi, false,
+           n_iters, &lam_ttft, &ok_ttft);
+  if (ln.target_itl > 0.0)
+    bisect(ln, g, lam_min, lam_max, ln.target_itl, itl_lo, itl_hi, true,
+           n_iters, &lam_itl, &ok_itl);
+  const double lam_tps =
+      ln.target_tps > 0.0 ? lam_max * (1.0 - kStabilitySafety) : lam_max;
+
+  const double lam_star = std::min({lam_ttft, lam_itl, lam_tps});
+  *feasible = (ok_ttft && ok_itl) ? 1 : 0;
+  *lambda_star = lam_star;
+
+  const double tput_star = solve_stats(lam_star, g).tput;
+  *rate_star = tput_star * 1000.0;  // req/sec
+
+  const double total = ln.target_tps > 0.0 ? ln.target_tps / ln.out_tokens
+                                           : ln.total_rate;
+  int32_t replicas =
+      static_cast<int32_t>(std::ceil(total / *rate_star));
+  replicas = std::max(replicas, ln.min_replicas);
+  replicas = std::max(replicas, 1);
+  *num_replicas = replicas;
+  *cost = replicas * ln.cost_per_replica;
+
+  double per_replica = total / replicas / 1000.0;  // req/msec
+  per_replica = std::max(per_replica, lam_min);
+  const Stats s = solve_stats(per_replica, g);
+  const double conc = concurrency(ln, s.serv);
+  const double prefill =
+      ln.in_tokens > 0.0 ? ln.gamma + ln.delta * ln.in_tokens * conc : 0.0;
+  *itl_out = ln.alpha + ln.beta * conc;
+  *ttft_out = s.wait + prefill;
+  *rho = std::clamp(s.in_servers / ln.max_batch, 0.0, 1.0);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. All arrays have n_lanes elements.
+int inferno_fleet_size(
+    int32_t n_lanes, const double* alpha, const double* beta,
+    const double* gamma, const double* delta, const double* in_tokens,
+    const double* out_tokens, const int32_t* max_batch,
+    const int32_t* occupancy_cap, const double* target_ttft,
+    const double* target_itl, const double* target_tps,
+    const double* total_rate, const int32_t* min_replicas,
+    const double* cost_per_replica, int32_t n_iters, int32_t n_threads,
+    uint8_t* feasible, double* lambda_star, double* rate_star,
+    int32_t* num_replicas, double* cost, double* itl, double* ttft,
+    double* rho) {
+  if (n_lanes < 0 || n_iters <= 0) return 1;
+  auto run = [&](int32_t i) {
+    Lane ln;
+    ln.alpha = alpha[i];
+    ln.beta = beta[i];
+    ln.gamma = gamma[i];
+    ln.delta = delta[i];
+    ln.in_tokens = in_tokens[i];
+    ln.out_tokens = out_tokens[i];
+    ln.max_batch = max_batch[i];
+    ln.occupancy_cap = occupancy_cap[i];
+    ln.target_ttft = target_ttft[i];
+    ln.target_itl = target_itl[i];
+    ln.target_tps = target_tps[i];
+    ln.total_rate = total_rate[i];
+    ln.min_replicas = min_replicas[i];
+    ln.cost_per_replica = cost_per_replica[i];
+    if (ln.max_batch <= 0 || ln.occupancy_cap < ln.max_batch ||
+        ln.out_tokens < 1.0 || service_time(ln, 1.0) <= 0.0) {
+      feasible[i] = 0;
+      lambda_star[i] = rate_star[i] = cost[i] = itl[i] = ttft[i] = rho[i] = 0.0;
+      num_replicas[i] = 0;
+      return;
+    }
+    size_lane(ln, n_iters, &feasible[i], &lambda_star[i], &rate_star[i],
+              &num_replicas[i], &cost[i], &itl[i], &ttft[i], &rho[i]);
+  };
+
+  const int32_t workers =
+      std::max<int32_t>(1, std::min<int32_t>(n_threads, n_lanes));
+  if (workers == 1) {
+    for (int32_t i = 0; i < n_lanes; ++i) run(i);
+    return 0;
+  }
+  std::atomic<int32_t> next{0};
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  for (int32_t w = 0; w < workers; ++w) {
+    pool.emplace_back([&]() {
+      for (int32_t i = next.fetch_add(1); i < n_lanes; i = next.fetch_add(1))
+        run(i);
+    });
+  }
+  for (auto& t : pool) t.join();
+  return 0;
+}
+
+}  // extern "C"

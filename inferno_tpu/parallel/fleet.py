@@ -230,13 +230,17 @@ def calculate_fleet(
     system: System,
     mesh: jax.sharding.Mesh | None = None,
     use_mesh: bool = False,
+    backend: str = "tpu",
 ) -> int:
-    """Replace System.calculate_all() with the batched TPU path.
+    """Replace System.calculate_all() with the batched fleet path.
 
-    Returns the number of live lanes sized. Semantics match the scalar
-    path: infeasible lanes produce no candidate; zero-load servers get the
-    closed-form shortcut; every candidate's solver value is the transition
-    penalty from the server's current allocation.
+    `backend` selects the batched solver: "tpu" (the jitted XLA kernel,
+    optionally sharded over `mesh`) or "native" (the C++ solver in
+    inferno_tpu.native, for controller deployments without a TPU
+    attachment). Returns the number of live lanes sized. Semantics match
+    the scalar path: infeasible lanes produce no candidate; zero-load
+    servers get the closed-form shortcut; every candidate's solver value
+    is the transition penalty from the server's current allocation.
     """
     if use_mesh and mesh is None:
         mesh = fleet_mesh()
@@ -287,7 +291,12 @@ def calculate_fleet(
     system.candidates_calculated = True
     if plan is None:
         return n_disagg
-    result = solve_fleet(plan, mesh=mesh)
+    if backend == "native":
+        from inferno_tpu.native import fleet_size_native
+
+        result = fleet_size_native(plan.params)
+    else:
+        result = solve_fleet(plan, mesh=mesh)
 
     for i, (server_name, acc_name) in enumerate(plan.lanes):
         if not bool(result.feasible[i]):

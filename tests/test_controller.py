@@ -114,7 +114,7 @@ def make_cluster(replicas=1, arrival_note=None, min_profile=False):
 
 
 def reconciler(cluster, prom, **kw):
-    cfg = ReconcilerConfig(config_namespace=CFG_NS, use_tpu_fleet=False, **kw)
+    cfg = ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar", **kw)
     return Reconciler(kube=cluster, prom=prom, config=cfg)
 
 
@@ -240,7 +240,7 @@ def test_direct_scale_actuation():
 def test_tpu_fleet_backend_matches_scalar():
     c1, c2 = make_cluster(), make_cluster()
     rec_scalar = reconciler(c1, make_prom(arrival_rps=50.0))
-    cfg = ReconcilerConfig(config_namespace=CFG_NS, use_tpu_fleet=True)
+    cfg = ReconcilerConfig(config_namespace=CFG_NS, compute_backend="tpu")
     rec_fleet = Reconciler(kube=c2, prom=make_prom(arrival_rps=50.0), config=cfg)
     rec_scalar.run_cycle()
     rec_fleet.run_cycle()

@@ -213,6 +213,19 @@ class TestSpecRoundTrip:
         back = ModelPerfSpec.from_dict(spec.to_dict())
         assert back.disagg is None
 
+    def test_empty_mapping_enables_defaults(self):
+        # "disagg": {} means "enable with defaults", not "absent"
+        spec = ModelPerfSpec.from_dict(
+            {"name": "m", "acc": "v5e-4", "disagg": {}}
+        )
+        assert spec.disagg == DisaggSpec()
+
+    def test_invalid_compute_backend_rejected(self):
+        from inferno_tpu.controller import ReconcilerConfig
+
+        with pytest.raises(ValueError):
+            ReconcilerConfig(compute_backend="Native")
+
     def test_explicit_zero_engines_not_coerced(self):
         # an explicit invalid 0 must survive parsing so validation rejects it
         spec = DisaggSpec.from_dict({"prefillSlices": 0, "decodeSlices": 4})

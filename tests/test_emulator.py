@@ -147,7 +147,7 @@ def test_e2e_scale_out_then_in():
     cluster = _cluster_for_emulator()
     rec = Reconciler(
         kube=cluster, prom=prom,
-        config=ReconcilerConfig(config_namespace=CFG_NS, use_tpu_fleet=False,
+        config=ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar",
                                 direct_scale=True),
     )
     try:
