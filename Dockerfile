@@ -1,0 +1,24 @@
+# Controller / emulator image. One image serves both entrypoints:
+#   python -m inferno_tpu.controller.main   (the autoscaler)
+#   python -m inferno_tpu.emulator.server   (the emulated TPU engine)
+# The native C++ solver is compiled at build time so the runtime needs no
+# toolchain; JAX (CPU) backs the "tpu" compute backend when a TPU
+# attachment is present in the pod.
+FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY inferno_tpu ./inferno_tpu
+RUN g++ -O3 -std=c++17 -shared -fPIC \
+      -o inferno_tpu/native/libinferno_queueing.so \
+      inferno_tpu/native/queueing.cc -pthread \
+    && pip install --no-cache-dir build && python -m build --wheel
+
+FROM python:3.12-slim
+RUN useradd --uid 65532 --create-home nonroot
+COPY --from=build /src/dist/*.whl /tmp/
+RUN pip install --no-cache-dir /tmp/*.whl "numpy>=1.26" "pyyaml>=6" \
+    && rm /tmp/*.whl
+USER 65532
+ENTRYPOINT ["python", "-m", "inferno_tpu.controller.main"]

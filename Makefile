@@ -1,0 +1,64 @@
+# Build/test/deploy entry points. Analogue of the reference Makefile
+# (/root/reference/Makefile:83-178) for the TPU-native build.
+
+PYTHON ?= python
+IMG ?= inferno-tpu-autoscaler:latest
+CLUSTER ?= inferno-tpu
+
+.PHONY: all test test-unit test-e2e bench native lint \
+        manifests-sync docker-build deploy-kind deploy undeploy clean
+
+all: native test
+
+## -- Development -------------------------------------------------------------
+
+# Full suite (unit + controller + in-process e2e with the emulator).
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+# Math/library tiers only (fast; no HTTP servers).
+test-unit:
+	$(PYTHON) -m pytest tests/ -x -q \
+	  --ignore=tests/test_emulator.py --ignore=tests/test_e2e.py
+
+# e2e tier: emulator HTTP server + controller loop end to end.
+test-e2e:
+	$(PYTHON) -m pytest tests/test_emulator.py tests/test_e2e.py -x -q
+
+# Benchmark: one JSON line (fleet sizing cycle vs reference algorithm).
+bench:
+	$(PYTHON) bench.py
+
+# Build the native C++ solver in place (also built on demand at import).
+native:
+	g++ -O3 -std=c++17 -shared -fPIC \
+	  -o inferno_tpu/native/libinferno_queueing.so \
+	  inferno_tpu/native/queueing.cc -pthread
+
+lint:
+	$(PYTHON) -m compileall -q inferno_tpu tests
+
+# Keep the Helm chart's CRD copy identical to the canonical manifest.
+manifests-sync:
+	cp deploy/crd/llmd.ai_variantautoscalings.yaml \
+	  charts/inferno-tpu-autoscaler/crds/llmd.ai_variantautoscalings.yaml
+
+## -- Packaging / deployment --------------------------------------------------
+
+docker-build:
+	docker build -t $(IMG) .
+
+# Emulated e2e stack on kind with fake google.com/tpu resources.
+deploy-kind:
+	ENVIRONMENT=kind-emulator ./deploy/install.sh
+
+# Controller stack onto the current kubectl context.
+deploy:
+	ENVIRONMENT=kubernetes ./deploy/install.sh
+
+undeploy:
+	kubectl delete -k deploy/manifests --ignore-not-found=true
+
+clean:
+	rm -f inferno_tpu/native/libinferno_queueing.so
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

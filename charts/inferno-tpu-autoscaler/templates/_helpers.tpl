@@ -1,0 +1,15 @@
+{{- define "inferno-tpu.fullname" -}}
+{{- printf "%s" .Release.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "inferno-tpu.labels" -}}
+app.kubernetes.io/name: inferno-tpu-autoscaler
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
+{{- end -}}
+
+{{- define "inferno-tpu.selectorLabels" -}}
+app.kubernetes.io/name: inferno-tpu-autoscaler
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Install the inferno-tpu autoscaler stack.
+#
+# Analogue of the reference's orchestrating installer
+# (/root/reference/deploy/install.sh driven by Makefile:101-143):
+# ENVIRONMENT selects the target —
+#   kind-emulator : create the fake-TPU kind cluster, deploy the
+#                   controller + emulated engine + sample VA
+#   kubernetes    : deploy the controller stack onto the current context
+#
+# Prereqs: kubectl; kind for the emulator path; a Prometheus stack
+# (kube-prometheus) reachable at PROMETHEUS_BASE_URL for real metrics.
+set -euo pipefail
+
+ENVIRONMENT="${ENVIRONMENT:-kind-emulator}"
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+case "${ENVIRONMENT}" in
+  kind-emulator)
+    "${SCRIPT_DIR}/kind-tpu-emulator/setup.sh"
+    kubectl apply -k "${SCRIPT_DIR}/manifests"
+    kubectl create namespace workloads --dry-run=client -o yaml | kubectl apply -f -
+    kubectl apply -f "${SCRIPT_DIR}/samples/emulator-deployment.yaml"
+    kubectl apply -f "${SCRIPT_DIR}/samples/variantautoscaling-v5e.yaml"
+    echo "emulated stack deployed; point PROMETHEUS_BASE_URL at your"
+    echo "Prometheus (kube-prometheus) and apply samples/hpa-integration.yaml"
+    ;;
+  kubernetes)
+    kubectl apply -k "${SCRIPT_DIR}/manifests"
+    echo "controller deployed to namespace inferno-system"
+    ;;
+  *)
+    echo "ENVIRONMENT must be kind-emulator|kubernetes, got '${ENVIRONMENT}'" >&2
+    exit 1
+    ;;
+esac

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Create a kind cluster whose worker nodes advertise fake google.com/tpu
+# extended resources, so pod-slices schedule without TPU hardware.
+#
+# TPU analogue of the reference's kind GPU emulator
+# (/root/reference/deploy/kind-emulator/setup.sh): where that script
+# patches fake nvidia/amd/intel GPU capacity onto nodes, this one
+# patches `google.com/tpu` chips (4 per host, the v5e/v5p host
+# granularity) plus the GKE TPU topology labels the scheduler would see.
+#
+# Usage: setup.sh [--name CLUSTER] [--chips-per-node N] [--nodes N]
+set -euo pipefail
+
+CLUSTER_NAME="inferno-tpu"
+CHIPS_PER_NODE=4
+NUM_WORKERS=2
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --name) CLUSTER_NAME="$2"; shift 2 ;;
+    --chips-per-node) CHIPS_PER_NODE="$2"; shift 2 ;;
+    --nodes) NUM_WORKERS="$2"; shift 2 ;;
+    *) echo "unknown flag: $1" >&2; exit 1 ;;
+  esac
+done
+
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+if ! kind get clusters 2>/dev/null | grep -qx "${CLUSTER_NAME}"; then
+  kind create cluster --name "${CLUSTER_NAME}" \
+    --config "${SCRIPT_DIR}/kind-config.yaml"
+fi
+
+# Advertise fake TPU chips as an extended resource on every worker via
+# the status subresource (same mechanism the reference uses for fake
+# GPUs). Requires `kubectl proxy` because node status is not patchable
+# through the normal API path.
+kubectl proxy --port=8001 >/dev/null 2>&1 &
+PROXY_PID=$!
+trap 'kill ${PROXY_PID} 2>/dev/null || true' EXIT
+sleep 2
+
+for node in $(kubectl get nodes -o name | grep -v control-plane); do
+  node_name="${node#node/}"
+  curl -sf --header "Content-Type: application/json-patch+json" \
+    --request PATCH \
+    "http://127.0.0.1:8001/api/v1/nodes/${node_name}/status" \
+    --data "[{\"op\": \"add\", \"path\": \"/status/capacity/google.com~1tpu\", \"value\": \"${CHIPS_PER_NODE}\"}]" \
+    >/dev/null
+  echo "node ${node_name}: google.com/tpu=${CHIPS_PER_NODE}"
+done
+
+echo "cluster '${CLUSTER_NAME}' ready with fake TPU capacity"

@@ -23,6 +23,7 @@ from typing import Any, Mapping
 
 from inferno_tpu.config.types import (
     DecodeParms,
+    DisaggSpec,
     ModelPerfSpec,
     PrefillParms,
 )
@@ -79,11 +80,14 @@ class AcceleratorProfile:
     (reference: variantautoscaling_types.go:54-69)."""
 
     acc: str  # slice shape name
-    acc_count: int = 1  # slice units per replica
+    acc_count: int = 1  # slice units per replica (per engine when disagg)
     max_batch_size: int = 1
     at_tokens: int = 0  # tokens/request the max batch was profiled at
     decode_parms: DecodeParms = dataclasses.field(default_factory=DecodeParms)
     prefill_parms: PrefillParms = dataclasses.field(default_factory=PrefillParms)
+    # JetStream-style disaggregated serving: one replica is then an atomic
+    # unit of prefill+decode engines (inferno_tpu.analyzer.disagg)
+    disagg: DisaggSpec | None = None
 
     def to_perf_spec(self, model_id: str) -> ModelPerfSpec:
         return ModelPerfSpec(
@@ -94,10 +98,11 @@ class AcceleratorProfile:
             at_tokens=self.at_tokens or self.max_batch_size,
             decode_parms=self.decode_parms,
             prefill_parms=self.prefill_parms,
+            disagg=self.disagg,
         )
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out: dict[str, Any] = {
             "acc": self.acc,
             "accCount": self.acc_count,
             "maxBatchSize": self.max_batch_size,
@@ -115,12 +120,16 @@ class AcceleratorProfile:
                 },
             },
         }
+        if self.disagg is not None:
+            out["disagg"] = self.disagg.to_dict()
+        return out
 
     @classmethod
     def from_dict(cls, d: Mapping[str, Any]) -> "AcceleratorProfile":
         perf = d.get("perfParms", {}) or {}
         dp = perf.get("decodeParms", {}) or {}
         pp = perf.get("prefillParms", {}) or {}
+        dg = d.get("disagg")
         return cls(
             acc=d.get("acc", ""),
             acc_count=int(d.get("accCount", 1) or 1),
@@ -133,6 +142,7 @@ class AcceleratorProfile:
                 gamma=float(pp.get("gamma", 0) or 0),
                 delta=float(pp.get("delta", 0) or 0),
             ),
+            disagg=DisaggSpec.from_dict(dg) if dg is not None else None,
         )
 
 

