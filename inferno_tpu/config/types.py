@@ -29,6 +29,41 @@ def _get(d: Mapping[str, Any], *names: str, default: Any = None) -> Any:
     return default
 
 
+@dataclasses.dataclass(frozen=True)
+class PowerSpec:
+    """Piecewise-linear per-chip power profile: watts at idle, at an
+    inflection utilization `mid_util`, and at full utilization
+    (reference PowerSpec: pkg/config/types.go:40-45)."""
+
+    idle: float = 0.0  # watts per chip at 0 utilization
+    full: float = 0.0  # watts per chip at 100% utilization
+    mid_power: float = 0.0  # watts per chip at the inflection point
+    mid_util: float = 0.5  # utilization of the inflection point, (0,1)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "idle": self.idle,
+            "full": self.full,
+            "midPower": self.mid_power,
+            "midUtil": self.mid_util,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "PowerSpec":
+        idle = float(d.get("idle", 0.0) or 0.0)
+        full = float(d.get("full", 0.0) or 0.0)
+        # Explicit zeros are meaningful (midUtil 0 selects the linear
+        # fallback), so only a *missing* key gets a default.
+        mid_power = d.get("midPower")
+        mid_util = d.get("midUtil")
+        return cls(
+            idle=idle,
+            full=full,
+            mid_power=(idle + full) / 2 if mid_power is None else float(mid_power),
+            mid_util=0.5 if mid_util is None else float(mid_util),
+        )
+
+
 @dataclasses.dataclass
 class AcceleratorSpec:
     """One allocatable TPU slice shape.
@@ -45,6 +80,7 @@ class AcceleratorSpec:
     mem_per_chip_gb: float = 16.0  # HBM per chip
     mem_bw_gbs: float = 820.0  # HBM bandwidth per chip
     cost_per_chip_hr: float = 0.0  # cents per chip-hour
+    power: PowerSpec = dataclasses.field(default_factory=PowerSpec)
 
     def __post_init__(self) -> None:
         shape = slice_shape(self.name)
@@ -74,6 +110,7 @@ class AcceleratorSpec:
             "memPerChipGB": self.mem_per_chip_gb,
             "memBWGBs": self.mem_bw_gbs,
             "costPerChipHr": self.cost_per_chip_hr,
+            "power": self.power.to_dict(),
         }
 
     @classmethod
@@ -85,6 +122,7 @@ class AcceleratorSpec:
             mem_per_chip_gb=float(_get(d, "memPerChipGB", "memSize", default=16.0)),
             mem_bw_gbs=float(_get(d, "memBWGBs", "memBW", default=820.0)),
             cost_per_chip_hr=float(_get(d, "costPerChipHr", "cost", default=0.0)),
+            power=PowerSpec.from_dict(d.get("power", {}) or {}),
         )
 
 

@@ -61,6 +61,23 @@ class Accelerator:
         """Cents/hr for one slice."""
         return self.spec.cost
 
+    def power(self, util: float) -> float:
+        """Watts drawn by one slice at the given utilization in [0,1]:
+        piecewise-linear through (0, idle), (mid_util, mid_power),
+        (1, full), scaled to the slice's chip count (reference
+        Accelerator.{Calculate,Power}: pkg/core/accelerator.go:29-41)."""
+        p = self.spec.power
+        util = min(max(util, 0.0), 1.0)
+        if p.mid_util <= 0.0 or p.mid_util >= 1.0:
+            per_chip = p.idle + (p.full - p.idle) * util
+        elif util <= p.mid_util:
+            per_chip = p.idle + (p.mid_power - p.idle) / p.mid_util * util
+        else:
+            per_chip = p.mid_power + (p.full - p.mid_power) / (1.0 - p.mid_util) * (
+                util - p.mid_util
+            )
+        return per_chip * self.chips
+
 
 class Model:
     """A model with per-slice-shape performance profiles
@@ -176,11 +193,14 @@ class Server:
 
 @dataclasses.dataclass
 class PoolUsage:
-    """Chips allocated per pool after a solve
-    (reference AllocateByType: pkg/core/system.go:271-300)."""
+    """Chips, cost, and power allocated per pool after a solve
+    (reference AllocateByType: pkg/core/system.go:271-300; the reference
+    computes per-accelerator power but never aggregates it — we surface
+    expected fleet watts per pool from each allocation's utilization)."""
 
     chips: int = 0
     cost: float = 0.0
+    watts: float = 0.0
 
 
 class System:
@@ -236,8 +256,10 @@ class System:
             if acc is None or model is None:
                 continue
             u = usage.setdefault(acc.pool, PoolUsage())
-            u.chips += alloc.num_replicas * model.slices_per_replica(acc.name) * acc.chips
+            slices = alloc.num_replicas * model.slices_per_replica(acc.name)
+            u.chips += slices * acc.chips
             u.cost += alloc.cost
+            u.watts += slices * acc.power(alloc.rho)
         self.pool_usage = usage
         return usage
 

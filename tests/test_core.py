@@ -9,8 +9,9 @@ import math
 
 import pytest
 
-from inferno_tpu.config import AllocationData, ServerLoadSpec
+from inferno_tpu.config import AcceleratorSpec, AllocationData, PowerSpec, ServerLoadSpec
 from inferno_tpu.core import (
+    Accelerator,
     System,
     allocation_diff,
     create_allocation,
@@ -165,3 +166,65 @@ def test_pool_usage_accounting():
     usage = system.allocate_by_pool()
     assert usage["v5e"].chips == server.allocation.num_replicas * 16
     assert usage["v5e"].cost == pytest.approx(server.allocation.cost)
+
+
+def test_power_model_piecewise_linear():
+    # Per-chip piecewise profile through (0, idle), (mid_util, mid), (1, full),
+    # scaled to the slice's chip count (reference pkg/core/accelerator.go:29-41).
+    acc = Accelerator(
+        AcceleratorSpec(
+            name="v5e-4",
+            cost_per_chip_hr=1.2,
+            power=PowerSpec(idle=60.0, full=200.0, mid_power=150.0, mid_util=0.5),
+        )
+    )
+    assert acc.power(0.0) == pytest.approx(4 * 60.0)
+    assert acc.power(0.5) == pytest.approx(4 * 150.0)
+    assert acc.power(1.0) == pytest.approx(4 * 200.0)
+    # low segment slope (150-60)/0.5 = 180 W per unit util per chip
+    assert acc.power(0.25) == pytest.approx(4 * (60.0 + 180.0 * 0.25))
+    # high segment slope (200-150)/0.5 = 100
+    assert acc.power(0.75) == pytest.approx(4 * (150.0 + 100.0 * 0.25))
+    # out-of-range utilizations clamp
+    assert acc.power(-1.0) == acc.power(0.0)
+    assert acc.power(2.0) == acc.power(1.0)
+
+
+def test_power_model_degenerate_mid_util_falls_back_linear():
+    acc = Accelerator(
+        AcceleratorSpec(
+            name="v5e-1",
+            power=PowerSpec(idle=50.0, full=150.0, mid_power=0.0, mid_util=0.0),
+        )
+    )
+    assert acc.power(0.5) == pytest.approx(100.0)
+
+
+def test_power_spec_round_trip_and_defaults():
+    p = PowerSpec(idle=60.0, full=200.0, mid_power=150.0, mid_util=0.4)
+    assert PowerSpec.from_dict(p.to_dict()) == p
+    # missing midPower defaults to the idle/full midpoint
+    q = PowerSpec.from_dict({"idle": 100.0, "full": 300.0})
+    assert q.mid_power == pytest.approx(200.0)
+    assert q.mid_util == pytest.approx(0.5)
+
+
+def test_pool_usage_includes_power():
+    spec = make_system_spec()
+    for a in spec.accelerators:
+        a.power = PowerSpec(idle=60.0, full=200.0, mid_power=150.0, mid_util=0.5)
+    system = System(spec)
+    server = system.servers[spec.servers[0].name]
+    server.calculate(system)
+    alloc = server.all_allocations["v5e-16"]
+    server.set_allocation(alloc)
+    usage = system.allocate_by_pool()
+    acc = system.accelerators["v5e-16"]
+    assert usage["v5e"].watts == pytest.approx(alloc.num_replicas * acc.power(alloc.rho))
+    assert usage["v5e"].watts > 0
+
+
+def test_power_spec_explicit_zeros_preserved():
+    # midUtil: 0 selects the linear fallback and must survive round-trip
+    p = PowerSpec(idle=50.0, full=150.0, mid_power=0.0, mid_util=0.0)
+    assert PowerSpec.from_dict(p.to_dict()) == p
