@@ -17,7 +17,13 @@ SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
 
 case "${ENVIRONMENT}" in
   kind-emulator)
-    "${SCRIPT_DIR}/kind-tpu-emulator/setup.sh"
+    CLUSTER_NAME="${CLUSTER_NAME:-inferno-tpu}"
+    "${SCRIPT_DIR}/kind-tpu-emulator/setup.sh" --name "${CLUSTER_NAME}"
+    # build the controller/emulator image and side-load it into kind —
+    # the kind nodes cannot pull inferno-tpu-autoscaler:latest from a
+    # registry (the tag is fixed: the manifests reference it by name)
+    docker build -t inferno-tpu-autoscaler:latest "${SCRIPT_DIR}/.."
+    kind load docker-image inferno-tpu-autoscaler:latest --name "${CLUSTER_NAME}"
     kubectl apply -k "${SCRIPT_DIR}/manifests"
     kubectl create namespace workloads --dry-run=client -o yaml | kubectl apply -f -
     kubectl apply -f "${SCRIPT_DIR}/samples/emulator-deployment.yaml"

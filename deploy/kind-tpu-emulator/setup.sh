@@ -27,8 +27,22 @@ done
 SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
 
 if ! kind get clusters 2>/dev/null | grep -qx "${CLUSTER_NAME}"; then
-  kind create cluster --name "${CLUSTER_NAME}" \
-    --config "${SCRIPT_DIR}/kind-config.yaml"
+  # generate the cluster config so --nodes controls the worker count
+  CONFIG_FILE="$(mktemp)"
+  {
+    echo "kind: Cluster"
+    echo "apiVersion: kind.x-k8s.io/v1alpha4"
+    echo "nodes:"
+    echo "  - role: control-plane"
+    for _ in $(seq 1 "${NUM_WORKERS}"); do
+      echo "  - role: worker"
+      echo "    labels:"
+      echo "      cloud.google.com/gke-tpu-accelerator: tpu-v5-lite-podslice"
+      echo "      cloud.google.com/gke-tpu-topology: 2x2"
+    done
+  } > "${CONFIG_FILE}"
+  kind create cluster --name "${CLUSTER_NAME}" --config "${CONFIG_FILE}"
+  rm -f "${CONFIG_FILE}"
 fi
 
 # Advertise fake TPU chips as an extended resource on every worker via

@@ -18,6 +18,7 @@ controller.go:516-582):
   CONFIG_NAMESPACE              (default inferno-system)
   SERVING_ENGINE                vllm-tpu | jetstream
   METRICS_PORT                  (default 8443)
+  HEALTH_PORT                   (default 8081; liveness/readiness probes)
   COMPUTE_BACKEND               tpu | native | scalar (default tpu;
                                 USE_TPU_FLEET=false maps to scalar)
   DIRECT_SCALE                  true|false (default false; HPA otherwise)
@@ -55,7 +56,12 @@ def prom_config_from_env():
 
 def main() -> int:
     from inferno_tpu.controller.kube import RestKubeClient
-    from inferno_tpu.controller.metrics import MetricsEmitter, MetricsServer, Registry
+    from inferno_tpu.controller.metrics import (
+        HealthServer,
+        MetricsEmitter,
+        MetricsServer,
+        Registry,
+    )
     from inferno_tpu.controller.promclient import HttpPromClient
     from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
 
@@ -82,6 +88,10 @@ def main() -> int:
     emitter = MetricsEmitter(registry)
     server = MetricsServer(registry, port=int(os.environ.get("METRICS_PORT", "8443")))
     server.start()
+    # dedicated probe port so liveness/readiness don't ride the metrics
+    # listener (the manager Deployment probes :8081)
+    health = HealthServer(server.ready_flag, port=int(os.environ.get("HEALTH_PORT", "8081")))
+    health.start()
 
     config = ReconcilerConfig(
         config_namespace=os.environ.get("CONFIG_NAMESPACE", "inferno-system"),
@@ -105,6 +115,7 @@ def main() -> int:
     try:
         rec.run_forever(stop_check=lambda: stopping["stop"])
     finally:
+        health.stop()
         server.stop()
     return 0
 

@@ -132,31 +132,18 @@ class MetricsEmitter:
             self.scaling_total.inc({**labels, LABEL_DIRECTION: direction})
 
 
-class MetricsServer:
-    """Serves /metrics, /healthz, /readyz on a background thread."""
+class _RouteServer:
+    """Threaded HTTP listener serving a map of path -> () -> (code,
+    content-type, body)."""
 
-    def __init__(self, registry: Registry, port: int = 8443, host: str = ""):
-        self.registry = registry
-        registry_ref = registry
-        ready_flag = {"ready": True}
-        self.ready_flag = ready_flag
-
+    def __init__(self, routes: dict, port: int, host: str = ""):
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path == "/metrics":
-                    body = registry_ref.render().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/plain; version=0.0.4")
-                elif self.path == "/healthz":
-                    body = b"ok"
-                    self.send_response(200)
-                elif self.path == "/readyz":
-                    ok = ready_flag["ready"]
-                    body = b"ok" if ok else b"not ready"
-                    self.send_response(200 if ok else 503)
-                else:
-                    body = b"not found"
-                    self.send_response(404)
+                route = routes.get(self.path)
+                code, ctype, body = route() if route else (404, None, b"not found")
+                self.send_response(code)
+                if ctype:
+                    self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -177,3 +164,35 @@ class MetricsServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+
+
+def _probe_routes(ready_flag: dict) -> dict:
+    def readyz():
+        ok = ready_flag["ready"]
+        return (200, None, b"ok") if ok else (503, None, b"not ready")
+
+    return {"/healthz": lambda: (200, None, b"ok"), "/readyz": readyz}
+
+
+class HealthServer(_RouteServer):
+    """/healthz + /readyz on the dedicated probe port (reference serves
+    probes on their own port, cmd/main.go:250-257; the manager Deployment
+    probes :8081)."""
+
+    def __init__(self, ready_flag: dict, port: int = 8081, host: str = ""):
+        super().__init__(_probe_routes(ready_flag), port, host)
+
+
+class MetricsServer(_RouteServer):
+    """Serves /metrics (plus the probe routes, for single-port setups) on
+    a background thread."""
+
+    def __init__(self, registry: Registry, port: int = 8443, host: str = ""):
+        self.registry = registry
+        self.ready_flag = {"ready": True}
+
+        def metrics():
+            return (200, "text/plain; version=0.0.4", registry.render().encode())
+
+        routes = {"/metrics": metrics, **_probe_routes(self.ready_flag)}
+        super().__init__(routes, port, host)

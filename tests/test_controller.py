@@ -269,3 +269,28 @@ def test_condition_transition_time_stable():
     va2 = cluster.get_variant_autoscaling(NS, "llama-premium")
     t2 = va2.status.condition(TYPE_OPTIMIZATION_READY).last_transition_time
     assert t1 == t2  # status did not flip -> timestamp stable
+
+
+def test_health_server_probes():
+    # the manager Deployment probes /healthz and /readyz on a dedicated
+    # port (8081); HealthServer is what listens there
+    import urllib.error
+    import urllib.request
+
+    from inferno_tpu.controller.metrics import HealthServer, MetricsServer, Registry
+
+    ms = MetricsServer(Registry(), port=0)
+    hs = HealthServer(ms.ready_flag, port=0)
+    ms.start()
+    hs.start()
+    try:
+        base = f"http://127.0.0.1:{hs.port}"
+        assert urllib.request.urlopen(base + "/healthz").read() == b"ok"
+        assert urllib.request.urlopen(base + "/readyz").read() == b"ok"
+        ms.ready_flag["ready"] = False
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/readyz")
+        assert exc.value.code == 503
+    finally:
+        hs.stop()
+        ms.stop()

@@ -255,3 +255,27 @@ def test_kustomization_resources_exist():
     kust = load_all(os.path.join(base, "kustomization.yaml"))[0]
     for res in kust["resources"]:
         assert os.path.exists(os.path.join(base, res)), res
+
+
+def test_manifest_probe_ports_are_served():
+    # manager.yaml probes the `health` containerPort; the controller's
+    # HealthServer defaults to the same port, and the metrics port matches
+    # METRICS_PORT's default
+    import yaml
+
+    doc = yaml.safe_load(open(os.path.join(REPO, "deploy/manifests/manager.yaml")).read())
+    container = doc["spec"]["template"]["spec"]["containers"][0]
+    ports = {p["name"]: p["containerPort"] for p in container["ports"]}
+    assert ports["health"] == 8081  # HealthServer default in controller/main.py
+    assert ports["metrics"] == 8443  # MetricsServer default
+    assert container["livenessProbe"]["httpGet"]["port"] == "health"
+    assert container["readinessProbe"]["httpGet"]["port"] == "health"
+
+
+def test_servicemonitor_scheme_matches_plain_http_listener():
+    import yaml
+
+    docs = list(yaml.safe_load_all(open(os.path.join(REPO, "deploy/manifests/metrics-service.yaml")).read()))
+    sm = next(d for d in docs if d and d.get("kind") == "ServiceMonitor")
+    for ep in sm["spec"]["endpoints"]:
+        assert ep["scheme"] == "http"  # MetricsServer is plain HTTP
