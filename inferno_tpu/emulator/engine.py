@@ -34,10 +34,15 @@ class EngineProfile:
 
 @dataclasses.dataclass
 class RequestResult:
-    ttft_ms: float
-    latency_ms: float
+    ttft_ms: float  # wall-clock
+    latency_ms: float  # wall-clock
     in_tokens: int
     out_tokens: int
+    # Virtual-clock timings in profile (emulated) msec, free of host
+    # scheduling overhead — the unit the latency profile and analytic
+    # model speak (reference uses a tick Clock, vllm_model.py:46-64).
+    ttft_emu_ms: float = 0.0
+    latency_emu_ms: float = 0.0
 
 
 @dataclasses.dataclass
@@ -45,9 +50,12 @@ class _Request:
     in_tokens: int
     out_tokens: int
     arrived: float
+    arrived_emu: float = 0.0
     done_event: threading.Event = dataclasses.field(default_factory=threading.Event)
     first_token_at: float | None = None
     finished_at: float | None = None
+    first_token_emu: float = 0.0
+    finished_emu: float = 0.0
     tokens_done: int = 0
     prefilled: bool = False
 
@@ -66,6 +74,8 @@ class EmulatedEngine:
         # telemetry event windows (timestamp, payload) for the fake scrape
         self.arrivals: deque[float] = deque(maxlen=100_000)
         self.completions: deque[tuple[float, RequestResult]] = deque(maxlen=100_000)
+        self.emu_ms = 0.0  # virtual clock: emulated msec since start
+        self._last_tick_wall = time.time()  # wall time of the last clock advance
         self.started_at = time.time()
         self.thread = threading.Thread(target=self._loop, daemon=True)
 
@@ -82,6 +92,8 @@ class EmulatedEngine:
     def submit(self, in_tokens: int, out_tokens: int) -> _Request:
         req = _Request(in_tokens=in_tokens, out_tokens=max(out_tokens, 1), arrived=time.time())
         with self.lock:
+            elapsed = time.time() - self._last_tick_wall
+            req.arrived_emu = self.emu_ms + elapsed * 1000.0 / max(self.time_scale, 1e-9)
             self.waiting.append(req)
             self.arrivals.append(req.arrived)
         return req
@@ -97,6 +109,8 @@ class EmulatedEngine:
             latency_ms=(req.finished_at - req.arrived) * 1000.0,
             in_tokens=req.in_tokens,
             out_tokens=req.out_tokens,
+            ttft_emu_ms=req.first_token_emu - req.arrived_emu,
+            latency_emu_ms=req.finished_emu - req.arrived_emu,
         )
 
     @property
@@ -116,12 +130,20 @@ class EmulatedEngine:
 
     def _admit(self) -> None:
         with self.lock:
+            # An idle engine serves an arrival immediately in the modeled
+            # (discrete-event) world; any gap between arrival and this
+            # admission poll is host artifact, so restart its virtual
+            # wait-clock here. Admissions into a busy batch keep their
+            # stamps — waiting out the in-flight step is real queueing.
+            was_idle = not self.running
             kv_used = sum(r.in_tokens + r.tokens_done for r in self.running)
             while self.waiting and len(self.running) < self.profile.max_batch:
                 nxt = self.waiting[0]
                 if kv_used + nxt.in_tokens + nxt.out_tokens > self.profile.kv_tokens_capacity:
                     break  # KV admission control (vllm_model.py:254-467)
                 self.waiting.popleft()
+                if was_idle:
+                    nxt.arrived_emu = max(nxt.arrived_emu, self.emu_ms)
                 self.running.append(nxt)
                 kv_used += nxt.in_tokens
 
@@ -133,7 +155,13 @@ class EmulatedEngine:
                 batch = len(self.running)
                 new = [r for r in self.running if not r.prefilled]
             if batch == 0:
+                # idle: keep the virtual clock tracking wall time so
+                # arrival timestamps stay meaningful across quiet gaps
+                t0 = time.time()
                 time.sleep(0.0005)
+                with self.lock:
+                    self.emu_ms += (time.time() - t0) * 1000.0 / max(self.time_scale, 1e-9)
+                    self._last_tick_wall = time.time()
                 continue
             # one iteration: prefill for newly admitted + one decode step
             step_ms = p.alpha + p.beta * batch
@@ -144,13 +172,18 @@ class EmulatedEngine:
             now = time.time()
             finished: list[_Request] = []
             with self.lock:
+                self.emu_ms += step_ms
+                self._last_tick_wall = now
+                emu_now = self.emu_ms
                 for r in self.running:
                     if not r.prefilled:
                         r.prefilled = True
                         r.first_token_at = now
+                        r.first_token_emu = max(emu_now, r.arrived_emu)
                     r.tokens_done += 1
                     if r.tokens_done >= r.out_tokens:
                         r.finished_at = now
+                        r.finished_emu = max(emu_now, r.first_token_emu)
                         finished.append(r)
                 for r in finished:
                     self.running.remove(r)
@@ -162,6 +195,8 @@ class EmulatedEngine:
                                 latency_ms=(now - r.arrived) * 1000.0,
                                 in_tokens=r.in_tokens,
                                 out_tokens=r.out_tokens,
+                                ttft_emu_ms=r.first_token_emu - r.arrived_emu,
+                                latency_emu_ms=emu_now - r.arrived_emu,
                             ),
                         )
                     )
