@@ -19,7 +19,7 @@ controller.go:516-582):
   SERVING_ENGINE                vllm-tpu | jetstream
   METRICS_PORT                  (default 8443)
   HEALTH_PORT                   (default 8081; liveness/readiness probes)
-  COMPUTE_BACKEND               tpu | native | scalar (default tpu;
+  COMPUTE_BACKEND               tpu | tpu-pallas | native | scalar (default tpu;
                                 USE_TPU_FLEET=false maps to scalar)
   DIRECT_SCALE                  true|false (default false; HPA otherwise)
 """

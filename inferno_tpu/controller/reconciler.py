@@ -68,14 +68,15 @@ class ReconcilerConfig:
     config_namespace: str = "inferno-system"
     engine: str = "vllm-tpu"  # serving engine metric vocabulary
     scale_to_zero: bool = False  # reference env WVA_SCALE_TO_ZERO (utils.go:282-285)
-    # candidate-sizing backend: "tpu" (batched XLA kernel), "native" (C++
+    # candidate-sizing backend: "tpu" (batched XLA kernel), "tpu-pallas"
+    # (batched XLA + fused pallas stationary solve), "native" (C++
     # solver, no TPU attachment needed), or "scalar" (pure-Python loop)
     compute_backend: str = "tpu"
 
     def __post_init__(self) -> None:
-        if self.compute_backend not in ("tpu", "native", "scalar"):
+        if self.compute_backend not in ("tpu", "tpu-pallas", "native", "scalar"):
             raise ValueError(
-                f"compute_backend must be tpu|native|scalar, "
+                f"compute_backend must be tpu|tpu-pallas|native|scalar, "
                 f"got {self.compute_backend!r}"
             )
     direct_scale: bool = False  # actuate Deployments directly (no HPA)
@@ -372,7 +373,7 @@ class Reconciler:
         system = System(spec)
         t0 = time.perf_counter()
         try:
-            if self.config.compute_backend in ("tpu", "native"):
+            if self.config.compute_backend in ("tpu", "tpu-pallas", "native"):
                 from inferno_tpu.parallel import calculate_fleet
 
                 calculate_fleet(system, backend=self.config.compute_backend)

@@ -151,8 +151,18 @@ def _concurrency(p: FleetParams, serv: jax.Array) -> jax.Array:
     return jnp.where(denom > 0, safe, jnp.where(numer > 0, nmax, 0.0))
 
 
-def _ttft_itl_at(lam: jax.Array, p: FleetParams, grid: _Grid):
-    wait, serv, _, _ = _solve_stats(lam, grid)
+def _get_solver(use_pallas: bool):
+    """The stationary-solve implementation: XLA-composed (default) or the
+    fused pallas kernel (ops.pallas_queueing; interpret mode off-TPU)."""
+    if not use_pallas:
+        return _solve_stats
+    from inferno_tpu.ops import pallas_queueing
+
+    return pallas_queueing.solve_stats
+
+
+def _ttft_itl_at(lam: jax.Array, p: FleetParams, grid: _Grid, solve=_solve_stats):
+    wait, serv, _, _ = solve(lam, grid)
     conc = _concurrency(p, serv)
     prefill = jnp.where(p.in_tokens > 0, p.gamma + p.delta * p.in_tokens * conc, 0.0)
     return wait + prefill, p.alpha + p.beta * conc
@@ -168,6 +178,7 @@ def _bisect_increasing(
     y_hi: jax.Array,
     which: int,  # 0: ttft, 1: itl
     n_iters: int,
+    solve=_solve_stats,
 ):
     """Vectorized bisection for an increasing metric-of-rate.
 
@@ -182,7 +193,7 @@ def _bisect_increasing(
     def body(_, state):
         lo, hi = state
         mid = 0.5 * (lo + hi)
-        y = _ttft_itl_at(mid, p, grid)[which]
+        y = _ttft_itl_at(mid, p, grid, solve)[which]
         too_high = y > target
         return jnp.where(too_high, lo, mid), jnp.where(too_high, mid, hi)
 
@@ -193,11 +204,12 @@ def _bisect_increasing(
     return lam, feasible
 
 
-def fleet_analyze(lam: jax.Array, params: FleetParams, k_max: int):
+def fleet_analyze(lam: jax.Array, params: FleetParams, k_max: int, use_pallas: bool = False):
     """Per-replica operating point at arrival rates `lam` (req/msec):
     (ttft, itl, rho, throughput req/msec)."""
+    solve = _get_solver(use_pallas)
     grid = _make_grid(params, k_max)
-    wait, serv, in_servers, tput = _solve_stats(lam, grid)
+    wait, serv, in_servers, tput = solve(lam, grid)
     conc = _concurrency(params, serv)
     prefill = jnp.where(
         params.in_tokens > 0, params.gamma + params.delta * params.in_tokens * conc, 0.0
@@ -208,7 +220,10 @@ def fleet_analyze(lam: jax.Array, params: FleetParams, k_max: int):
 
 
 def fleet_size(
-    params: FleetParams, k_max: int, n_iters: int = DEFAULT_BISECT_ITERS
+    params: FleetParams,
+    k_max: int,
+    n_iters: int = DEFAULT_BISECT_ITERS,
+    use_pallas: bool = False,
 ) -> FleetResult:
     """Size every lane: max per-replica rate meeting TTFT/ITL/TPS targets,
     replica count for the offered load, cost, and the expected per-replica
@@ -216,6 +231,7 @@ def fleet_size(
     QueueAnalyzer.size + create_allocation's arithmetic
     (reference: pkg/analyzer/queueanalyzer.go:185-255 +
     pkg/core/allocation.go:126-157)."""
+    solve = _get_solver(use_pallas)
     grid = _make_grid(params, k_max)
     one = jnp.ones_like(params.alpha)
     mu_1 = _service_rate(params, one)
@@ -224,14 +240,16 @@ def fleet_size(
     lam_max = mu_n * (1.0 - _RATE_EPSILON)
 
     # metric values at both rate bounds, one solve per bound
-    ttft_lo, itl_lo = _ttft_itl_at(lam_min, params, grid)
-    ttft_hi, itl_hi = _ttft_itl_at(lam_max, params, grid)
+    ttft_lo, itl_lo = _ttft_itl_at(lam_min, params, grid, solve)
+    ttft_hi, itl_hi = _ttft_itl_at(lam_max, params, grid, solve)
 
     lam_ttft, ok_ttft = _bisect_increasing(
-        params, grid, lam_min, lam_max, params.target_ttft, ttft_lo, ttft_hi, 0, n_iters
+        params, grid, lam_min, lam_max, params.target_ttft, ttft_lo, ttft_hi, 0,
+        n_iters, solve,
     )
     lam_itl, ok_itl = _bisect_increasing(
-        params, grid, lam_min, lam_max, params.target_itl, itl_lo, itl_hi, 1, n_iters
+        params, grid, lam_min, lam_max, params.target_itl, itl_lo, itl_hi, 1,
+        n_iters, solve,
     )
     lam_ttft = jnp.where(params.target_ttft > 0, lam_ttft, lam_max)
     ok_ttft = jnp.where(params.target_ttft > 0, ok_ttft, True)
@@ -245,7 +263,7 @@ def fleet_size(
     feasible = ok_ttft & ok_itl
 
     # throughput at the binding rate -> per-replica capacity (req/sec)
-    tput_star = _solve_stats(lam_star, grid)[3]
+    tput_star = solve(lam_star, grid)[3]
     rate_star = tput_star * 1000.0
 
     # replicas for the offered load; TPS targets replace the offered rate
@@ -261,7 +279,7 @@ def fleet_size(
     # expected per-replica operating point
     per_replica_rate = total / replicas.astype(jnp.float32) / 1000.0  # req/msec
     per_replica_rate = jnp.maximum(per_replica_rate, lam_min)
-    wait, serv, in_servers, _ = _solve_stats(per_replica_rate, grid)
+    wait, serv, in_servers, _ = solve(per_replica_rate, grid)
     conc = _concurrency(params, serv)
     prefill = jnp.where(
         params.in_tokens > 0, params.gamma + params.delta * params.in_tokens * conc, 0.0
@@ -279,9 +297,11 @@ def fleet_size(
     )
 
 
-def make_fleet_size_fn(k_max: int, n_iters: int = DEFAULT_BISECT_ITERS):
+def make_fleet_size_fn(
+    k_max: int, n_iters: int = DEFAULT_BISECT_ITERS, use_pallas: bool = False
+):
     """Jitted fleet sizing specialized to a padded occupancy grid `k_max`."""
-    return jax.jit(lambda params: fleet_size(params, k_max, n_iters))
+    return jax.jit(lambda params: fleet_size(params, k_max, n_iters, use_pallas))
 
 
 def pack_result(res: FleetResult) -> jax.Array:
@@ -303,6 +323,10 @@ def unpack_result(arr) -> FleetResult:
     )
 
 
-def make_fleet_size_packed_fn(k_max: int, n_iters: int = DEFAULT_BISECT_ITERS):
+def make_fleet_size_packed_fn(
+    k_max: int, n_iters: int = DEFAULT_BISECT_ITERS, use_pallas: bool = False
+):
     """Jitted fleet sizing returning the packed [8, P] result."""
-    return jax.jit(lambda params: pack_result(fleet_size(params, k_max, n_iters)))
+    return jax.jit(
+        lambda params: pack_result(fleet_size(params, k_max, n_iters, use_pallas))
+    )

@@ -147,7 +147,7 @@ def build_fleet(system: System) -> FleetPlan | None:
     return FleetPlan(params=params, lanes=lanes)
 
 
-_fn_cache: dict[tuple[int, int], object] = {}
+_fn_cache: dict[tuple[int, int, bool], object] = {}
 
 
 def _bucket_k(cap: int) -> int:
@@ -162,11 +162,11 @@ def _bucket_k(cap: int) -> int:
     return k
 
 
-def _jitted(k_max: int, n_iters: int):
-    key = (k_max, n_iters)
+def _jitted(k_max: int, n_iters: int, use_pallas: bool = False):
+    key = (k_max, n_iters, use_pallas)
     fn = _fn_cache.get(key)
     if fn is None:
-        fn = make_fleet_size_packed_fn(k_max, n_iters)
+        fn = make_fleet_size_packed_fn(k_max, n_iters, use_pallas)
         _fn_cache[key] = fn
     return fn
 
@@ -175,6 +175,7 @@ def solve_fleet(
     plan: FleetPlan,
     mesh: jax.sharding.Mesh | None = None,
     n_iters: int = DEFAULT_BISECT_ITERS,
+    use_pallas: bool = False,
 ) -> FleetResult:
     """Run the jitted batched sizing; optionally shard lanes over a mesh.
 
@@ -214,7 +215,7 @@ def solve_fleet(
             )
         if mesh is not None:
             sub = shard_fleet_params(sub, mesh)
-        pending.append((idx, _jitted(k_bucket, n_iters)(sub)))
+        pending.append((idx, _jitted(k_bucket, n_iters, use_pallas)(sub)))
     # single device_get over every bucket: host copies are started for all
     # leaves before any is awaited (per-transfer latency overlaps — this
     # matters on tunneled TPU backends where each D2H fetch costs ~10ms)
@@ -235,9 +236,10 @@ def calculate_fleet(
     """Replace System.calculate_all() with the batched fleet path.
 
     `backend` selects the batched solver: "tpu" (the jitted XLA kernel,
-    optionally sharded over `mesh`) or "native" (the C++ solver in
-    inferno_tpu.native, for controller deployments without a TPU
-    attachment). Returns the number of live lanes sized. Semantics match
+    optionally sharded over `mesh`), "tpu-pallas" (same pipeline with the
+    fused pallas stationary-solve kernel, ops.pallas_queueing), or
+    "native" (the C++ solver in inferno_tpu.native, for controller
+    deployments without a TPU attachment). Returns the number of live lanes sized. Semantics match
     the scalar path: infeasible lanes produce no candidate; zero-load
     servers get the closed-form shortcut; every candidate's solver value
     is the transition penalty from the server's current allocation.
@@ -296,7 +298,7 @@ def calculate_fleet(
 
         result = fleet_size_native(plan.params)
     else:
-        result = solve_fleet(plan, mesh=mesh)
+        result = solve_fleet(plan, mesh=mesh, use_pallas=(backend == "tpu-pallas"))
 
     for i, (server_name, acc_name) in enumerate(plan.lanes):
         if not bool(result.feasible[i]):

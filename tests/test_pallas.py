@@ -1,0 +1,119 @@
+"""Pallas stationary-solve kernel vs the XLA-composed and scalar paths.
+
+On CPU (the test platform) the kernel runs in pallas interpret mode, so
+these tests execute the exact kernel code path the TPU compiles.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from inferno_tpu.analyzer.queue import RequestSize, build_analyzer
+from inferno_tpu.config.types import DecodeParms, PrefillParms
+from inferno_tpu.ops import queueing as q
+from inferno_tpu.ops import pallas_queueing as pq
+
+
+def _params(P, rng):
+    def arr(lo, hi):
+        return jnp.asarray(rng.uniform(lo, hi, P), jnp.float32)
+
+    return q.FleetParams(
+        alpha=arr(5, 25),
+        beta=arr(0.1, 0.5),
+        gamma=arr(2, 8),
+        delta=arr(0.005, 0.03),
+        in_tokens=arr(64, 512),
+        out_tokens=arr(32, 256),
+        max_batch=jnp.asarray(rng.integers(4, 24, P), jnp.float32),
+        occupancy_cap=jnp.asarray(rng.integers(40, 250, P), jnp.int32),
+        target_ttft=arr(200, 900),
+        target_itl=arr(15, 40),
+        target_tps=jnp.zeros(P),
+        total_rate=arr(0.5, 30),
+        min_replicas=jnp.ones(P, jnp.int32),
+        cost_per_replica=arr(1, 10),
+    )
+
+
+@pytest.mark.parametrize("P", [1, 8, 13])
+def test_solve_stats_matches_xla(P):
+    rng = np.random.default_rng(P)
+    params = _params(P, rng)
+    grid = q._make_grid(params, 256)
+    lam = jnp.asarray(rng.uniform(0.001, 0.02, P), jnp.float32)
+    ref = q._solve_stats(lam, grid)
+    got = pq.solve_stats(lam, grid)
+    # wait/serv compared on the response-time scale: the XLA path computes
+    # wait as resp - serv, which cancels in f32 when the queue is empty
+    scale = np.abs(np.asarray(ref[0])) + np.abs(np.asarray(ref[1])) + 1e-6
+    for name, r, g in zip(("wait", "serv", "in_servers", "tput"), ref, got):
+        r, g = np.asarray(r), np.asarray(g)
+        if name in ("wait", "serv"):
+            err = np.max(np.abs(r - g) / scale)
+        else:
+            err = np.max(np.abs(r - g) / (np.abs(r) + 1e-6))
+        assert err < 5e-3, (name, err)
+
+
+def test_fleet_size_decisions_match():
+    rng = np.random.default_rng(7)
+    params = _params(24, rng)
+    r_xla = q.fleet_size(params, 256, use_pallas=False)
+    r_pal = q.fleet_size(params, 256, use_pallas=True)
+    assert np.array_equal(np.asarray(r_xla.feasible), np.asarray(r_pal.feasible))
+    assert np.array_equal(
+        np.asarray(r_xla.num_replicas), np.asarray(r_pal.num_replicas)
+    )
+    assert np.allclose(np.asarray(r_xla.cost), np.asarray(r_pal.cost), rtol=1e-5)
+    assert np.allclose(
+        np.asarray(r_xla.rate_star), np.asarray(r_pal.rate_star), rtol=1e-2
+    )
+
+
+def test_kernel_against_scalar_analyzer():
+    """Ground truth: the float64 scalar analyzer."""
+    decode = DecodeParms(18.0, 0.3)
+    prefill = PrefillParms(5.0, 0.02)
+    req = RequestSize(avg_in_tokens=128, avg_out_tokens=64)
+    qa = build_analyzer(
+        max_batch=16, max_queue=160, decode=decode, prefill=prefill, request=req
+    )
+    rate = 0.8  # req/s, stable region
+    m = qa.analyze(rate)
+
+    P = 1
+    params = q.FleetParams(
+        alpha=jnp.full(P, 18.0),
+        beta=jnp.full(P, 0.3),
+        gamma=jnp.full(P, 5.0),
+        delta=jnp.full(P, 0.02),
+        in_tokens=jnp.full(P, 128.0),
+        out_tokens=jnp.full(P, 64.0),
+        max_batch=jnp.full(P, 16.0),
+        occupancy_cap=jnp.full(P, 176, dtype=jnp.int32),
+        target_ttft=jnp.zeros(P),
+        target_itl=jnp.zeros(P),
+        target_tps=jnp.zeros(P),
+        total_rate=jnp.full(P, rate),
+        min_replicas=jnp.ones(P, jnp.int32),
+        cost_per_replica=jnp.ones(P),
+    )
+    grid = q._make_grid(params, 256)
+    lam = jnp.asarray([rate / 1000.0], jnp.float32)
+    wait, serv, in_servers, tput = pq.solve_stats(lam, grid)
+    assert float(tput[0]) * 1000.0 == pytest.approx(m.throughput, rel=1e-3)
+    assert float(wait[0]) == pytest.approx(m.avg_wait_time, rel=2e-2, abs=0.05)
+
+
+def test_padding_lanes_are_neutral():
+    """P not divisible by TILE_P exercises the padding path; results for
+    real lanes must be identical to a padded-free batch."""
+    rng = np.random.default_rng(3)
+    params5 = _params(5, rng)
+    grid5 = q._make_grid(params5, 128)
+    lam = jnp.asarray(rng.uniform(0.001, 0.01, 5), jnp.float32)
+    got5 = pq.solve_stats(lam, grid5)
+    for f in got5:
+        assert np.all(np.isfinite(np.asarray(f)))
+        assert np.asarray(f).shape == (5,)
