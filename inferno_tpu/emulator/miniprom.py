@@ -1,0 +1,231 @@
+"""A miniature Prometheus for sockets-level e2e testing.
+
+The reference's e2e tier deploys kube-prometheus on Kind to sit between
+the emulated engines and the controller
+(/root/reference/Makefile:146-156, test/e2e/e2e_test.go:341-517). This
+module is the hardware-free, cluster-free equivalent: an HTTP server
+that *scrapes* real `/metrics` exposition endpoints over sockets,
+keeps a short sample history, and answers the controller collector's
+query shapes on `/api/v1/query` in the Prometheus JSON wire format —
+so an e2e test exercises the full metrics path:
+
+    engine /metrics exposition -> scrape+parse -> rate()/ratio eval
+    -> /api/v1/query JSON -> HttpPromClient -> collector -> reconciler
+
+Supported query shapes (exactly what the collector emits,
+inferno_tpu.controller.collector):
+
+* `sum(rate(NAME{sel}[1m]))`                      -> windowed counter rate
+* `sum(rate(A{sel}[1m]))/sum(rate(B{sel}[1m]))`   -> ratio of rates
+* `NAME{sel}`                                     -> latest instant vector
+* `up`                                            -> 1 per scrape target
+
+The `[1m]` literal is cosmetic: the evaluation window is the
+constructor's `window_seconds` so tests can compress time.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.parse
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([^\s]+)")
+_MATCHER = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse_exposition(text: str):
+    """Parse text exposition into [(name, labels_dict, value)]."""
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if not m:
+            continue
+        name, raw_labels, raw_val = m.groups()
+        try:
+            value = float(raw_val)
+        except ValueError:
+            continue
+        labels = dict(_MATCHER.findall(raw_labels)) if raw_labels else {}
+        out.append((name, labels, value))
+    return out
+
+
+def _parse_vector_selector(expr: str):
+    """`name{a="b",...}` -> (name, {a: b}); bare `name` -> (name, {})."""
+    brace = expr.find("{")
+    if brace < 0:
+        return expr.strip(), {}
+    return expr[:brace].strip(), dict(_MATCHER.findall(expr[brace:]))
+
+
+_RATE = re.compile(r"sum\(rate\(([^\[]+)\[[^\]]*\]\)\)")
+
+
+class MiniProm:
+    """Scrapes `targets` every `scrape_interval` seconds; serves
+    /api/v1/query. Start with `start()`; URL at `self.url`."""
+
+    def __init__(
+        self,
+        targets: list,
+        scrape_interval: float = 0.5,
+        window_seconds: float = 60.0,
+        port: int = 0,
+    ):
+        # each target: "url" or ("url", {extra labels}) — extra labels play
+        # the role of Prometheus target relabeling (e.g. the namespace label
+        # a ServiceMonitor attaches to every series of a scraped pod)
+        self.targets = [t if isinstance(t, tuple) else (t, {}) for t in targets]
+        self.scrape_interval = scrape_interval
+        self.window_seconds = window_seconds
+        # (target, name, labels_key) -> deque[(t, value)]
+        self.history: dict[tuple, deque] = {}
+        self.lock = threading.Lock()
+        self._stop = threading.Event()
+        self._scraper: threading.Thread | None = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path != "/api/v1/query":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                query = urllib.parse.parse_qs(parsed.query).get("query", [""])[0]
+                body = json.dumps(outer.evaluate(query)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_port
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    # -- scraping ------------------------------------------------------------
+
+    def add_target(self, url: str, labels: dict | None = None) -> None:
+        with self.lock:
+            self.targets.append((url, labels or {}))
+
+    def scrape_once(self) -> None:
+        with self.lock:
+            targets = list(self.targets)
+        now = time.time()
+        for target, extra in targets:
+            try:
+                with urllib.request.urlopen(target, timeout=5) as resp:
+                    text = resp.read().decode()
+            except OSError:
+                continue
+            series = parse_exposition(text)
+            with self.lock:
+                for name, labels, value in series:
+                    # series-native labels win over target labels
+                    merged = {**extra, **labels}
+                    key = (target, name, tuple(sorted(merged.items())))
+                    self.history.setdefault(key, deque(maxlen=512)).append((now, value))
+
+    def _scrape_loop(self) -> None:
+        while not self._stop.is_set():
+            self.scrape_once()
+            self._stop.wait(self.scrape_interval)
+
+    def start(self) -> None:
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        self._scraper = threading.Thread(target=self._scrape_loop, daemon=True)
+        self._scraper.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+
+    # -- evaluation ----------------------------------------------------------
+
+    def _matching(self, name: str, matchers: dict):
+        """All series histories matching name + label equality matchers."""
+        with self.lock:
+            items = list(self.history.items())
+        out = []
+        for (target, sname, labels_key), hist in items:
+            if sname != name:
+                continue
+            labels = dict(labels_key)
+            if all(labels.get(k) == v for k, v in matchers.items()):
+                out.append((labels, list(hist)))
+        return out
+
+    def _rate(self, name: str, matchers: dict) -> float:
+        """Windowed counter rate summed over matching series: positive
+        deltas within the window / covered time (counter-reset safe)."""
+        cutoff = time.time() - self.window_seconds
+        total = 0.0
+        elapsed = 0.0
+        for _, hist in self._matching(name, matchers):
+            pts = [(t, v) for t, v in hist if t >= cutoff]
+            if len(pts) < 2:
+                continue
+            inc = sum(
+                max(b[1] - a[1], 0.0) for a, b in zip(pts, pts[1:])
+            )
+            total += inc
+            elapsed = max(elapsed, pts[-1][0] - pts[0][0])
+        if elapsed <= 0:
+            return 0.0
+        return total / elapsed
+
+    def evaluate(self, query: str) -> dict:
+        query = query.strip()
+
+        def vector(results):
+            return {
+                "status": "success",
+                "data": {"resultType": "vector", "result": results},
+            }
+
+        if query == "up":
+            now = time.time()
+            with self.lock:
+                targets = list(self.targets)
+            return vector(
+                [
+                    {"metric": {"instance": t}, "value": [now, "1"]}
+                    for t, _ in targets
+                ]
+            )
+
+        rates = _RATE.findall(query)
+        if rates:
+            selectors = [_parse_vector_selector(r) for r in rates]
+            values = [self._rate(name, matchers) for name, matchers in selectors]
+            if len(values) == 2 and ")/sum(rate(" in query.replace(" ", ""):
+                value = values[0] / values[1] if values[1] > 0 else 0.0
+            else:
+                value = values[0]
+            name, matchers = selectors[0]
+            if not self._matching(name, matchers):
+                return vector([])
+            return vector(
+                [{"metric": dict(matchers), "value": [time.time(), str(value)]}]
+            )
+
+        # instant vector selector
+        name, matchers = _parse_vector_selector(query)
+        results = []
+        for labels, hist in self._matching(name, matchers):
+            t, v = hist[-1]
+            results.append({"metric": labels, "value": [t, str(v)]})
+        return vector(results)

@@ -98,6 +98,11 @@ def _service_rate(p: FleetParams, n: jax.Array) -> jax.Array:
 def _make_grid(p: FleetParams, k_max: int) -> _Grid:
     k = jnp.arange(1, k_max + 1, dtype=jnp.float32)[None, :]  # [1, K]
     nmax = p.max_batch.astype(jnp.float32)
+    # a cap beyond the padded grid is truncated to the grid edge: the
+    # blocking state must exist on the grid or blocking mass is lost
+    # (production bucketing guarantees k_max >= cap; this keeps direct
+    # callers well-defined and the XLA/pallas backends in agreement)
+    cap = jnp.minimum(p.occupancy_cap, k_max)
     n_eff = jnp.minimum(k, nmax[:, None])
     prefill = jnp.where(
         p.in_tokens[:, None] > 0,
@@ -106,14 +111,14 @@ def _make_grid(p: FleetParams, k_max: int) -> _Grid:
     )
     decode = _num_decodes(p)[:, None] * (p.alpha[:, None] + p.beta[:, None] * n_eff)
     log_mu = jnp.log(n_eff) - jnp.log(prefill + decode)
-    valid = k <= p.occupancy_cap.astype(jnp.float32)[:, None]
+    valid = k <= cap.astype(jnp.float32)[:, None]
     log_mu = jnp.where(valid, log_mu, jnp.inf)  # +inf => p[k] = 0 beyond cap
     kk = jnp.arange(0, k_max + 1, dtype=jnp.float32)[None, :]
     return _Grid(
         cml=jnp.cumsum(log_mu, axis=1),
         kk=kk,
         le_n=kk <= nmax[:, None],
-        cap_idx=p.occupancy_cap[:, None],
+        cap_idx=cap[:, None],
         nmax=nmax,
     )
 
