@@ -55,6 +55,15 @@ class KubeClient(Protocol):
 
     def get_configmap(self, namespace: str, name: str) -> dict[str, str]: ...
 
+    def list_nodes(self) -> list[dict]: ...
+
+    # coordination.k8s.io leases (leader election)
+    def get_lease(self, namespace: str, name: str) -> dict: ...
+
+    def create_lease(self, namespace: str, name: str, lease: dict) -> dict: ...
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict: ...
+
 
 # -- in-memory fake ----------------------------------------------------------
 
@@ -67,6 +76,8 @@ class InMemoryCluster:
         self._vas: dict[tuple[str, str], dict] = {}
         self._deployments: dict[tuple[str, str], dict] = {}
         self._configmaps: dict[tuple[str, str], dict[str, str]] = {}
+        self._nodes: dict[str, dict] = {}
+        self._leases: dict[tuple[str, str], dict] = {}
 
     # seeding helpers -------------------------------------------------------
 
@@ -134,6 +145,66 @@ class InMemoryCluster:
         if d is None:
             raise NotFound(f"configmap {namespace}/{name}")
         return dict(d)
+
+    def add_node(
+        self,
+        name: str,
+        tpu_chips: int = 0,
+        accelerator: str = "",
+        unschedulable: bool = False,
+        labels: dict | None = None,
+    ) -> None:
+        labels = dict(labels or {})
+        if accelerator:
+            labels["cloud.google.com/gke-tpu-accelerator"] = accelerator
+        node = {
+            "metadata": {"name": name, "labels": labels},
+            "spec": {"unschedulable": unschedulable},
+            "status": {
+                "allocatable": {"google.com/tpu": str(tpu_chips)} if tpu_chips else {}
+            },
+        }
+        self._nodes[name] = node
+
+    def list_nodes(self) -> list[dict]:
+        return [copy.deepcopy(n) for n in self._nodes.values()]
+
+    # leases with optimistic concurrency (resourceVersion), so election
+    # races behave as they would against a real API server
+    def get_lease(self, namespace: str, name: str) -> dict:
+        d = self._leases.get((namespace, name))
+        if d is None:
+            raise NotFound(f"lease {namespace}/{name}")
+        return copy.deepcopy(d)
+
+    def create_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        if (namespace, name) in self._leases:
+            raise Conflict(f"lease {namespace}/{name} exists")
+        stored = copy.deepcopy(lease)
+        stored.setdefault("metadata", {}).update(
+            {"name": name, "namespace": namespace, "resourceVersion": "1"}
+        )
+        self._leases[(namespace, name)] = stored
+        return copy.deepcopy(stored)
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        cur = self._leases.get((namespace, name))
+        if cur is None:
+            raise NotFound(f"lease {namespace}/{name}")
+        sent_rv = (lease.get("metadata", {}) or {}).get("resourceVersion")
+        cur_rv = cur["metadata"]["resourceVersion"]
+        if sent_rv is not None and sent_rv != cur_rv:
+            raise Conflict(f"lease {namespace}/{name}: resourceVersion mismatch")
+        stored = copy.deepcopy(lease)
+        stored.setdefault("metadata", {}).update(
+            {
+                "name": name,
+                "namespace": namespace,
+                "resourceVersion": str(int(cur_rv) + 1),
+            }
+        )
+        self._leases[(namespace, name)] = stored
+        return copy.deepcopy(stored)
 
 
 # -- REST client -------------------------------------------------------------
@@ -290,3 +361,28 @@ class RestKubeClient:
             )
         )
         return dict(out.get("data", {}) or {})
+
+    def list_nodes(self) -> list[dict]:
+        out = with_backoff(lambda: self._request("GET", "/api/v1/nodes"))
+        return list(out.get("items", []) or [])
+
+    def _lease_path(self, namespace: str, name: str = "") -> str:
+        p = f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        return f"{p}/{name}" if name else p
+
+    # no backoff on lease ops: election rounds are themselves the retry
+    # loop, and a stale retry after a conflict must not clobber the winner
+    def get_lease(self, namespace: str, name: str) -> dict:
+        return self._request("GET", self._lease_path(namespace, name))
+
+    def create_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        body = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": name, "namespace": namespace},
+            **{k: v for k, v in lease.items() if k != "metadata"},
+        }
+        return self._request("POST", self._lease_path(namespace), body)
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        return self._request("PUT", self._lease_path(namespace, name), lease)

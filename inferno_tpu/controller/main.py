@@ -18,10 +18,14 @@ controller.go:516-582):
   CONFIG_NAMESPACE              (default inferno-system)
   SERVING_ENGINE                vllm-tpu | jetstream
   METRICS_PORT                  (default 8443)
+  METRICS_TLS_CERT_PATH/KEY_PATH  serve /metrics over TLS, certs reloaded
+                                on rotation; plain HTTP when unset
   HEALTH_PORT                   (default 8081; liveness/readiness probes)
   COMPUTE_BACKEND               tpu | tpu-pallas | native | scalar (default tpu;
                                 USE_TPU_FLEET=false maps to scalar)
   DIRECT_SCALE                  true|false (default false; HPA otherwise)
+  LEADER_ELECT                  true|false (default false; lease-based
+                                election for multi-replica deployments)
 """
 
 from __future__ import annotations
@@ -65,9 +69,13 @@ def main() -> int:
     from inferno_tpu.controller.promclient import HttpPromClient
     from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
 
+    from inferno_tpu.controller.logger import get_logger
+
+    log = get_logger("inferno.main")
+
     prom_cfg = prom_config_from_env()
     if not prom_cfg.base_url:
-        print("PROMETHEUS_BASE_URL is required", file=sys.stderr)
+        log.error("PROMETHEUS_BASE_URL is required")
         return 2
     prom = HttpPromClient(prom_cfg)
     # connectivity gate with backoff (reference: utils.go:390-410 called
@@ -76,17 +84,23 @@ def main() -> int:
     for _ in range(6):
         if prom.healthy():
             break
-        print(f"prometheus not reachable; retrying in {delay}s", file=sys.stderr)
+        log.warning("prometheus not reachable; retrying in %ss", delay)
         time.sleep(delay)
         delay *= 2
     else:
-        print("prometheus unreachable; exiting", file=sys.stderr)
+        log.error("prometheus unreachable; exiting")
         return 1
+
+    from inferno_tpu.controller.metrics import TLSConfig
 
     kube = RestKubeClient()
     registry = Registry()
     emitter = MetricsEmitter(registry)
-    server = MetricsServer(registry, port=int(os.environ.get("METRICS_PORT", "8443")))
+    server = MetricsServer(
+        registry,
+        port=int(os.environ.get("METRICS_PORT", "8443")),
+        tls=TLSConfig.from_env(),
+    )
     server.start()
     # dedicated probe port so liveness/readiness don't ride the metrics
     # listener (the manager Deployment probes :8081)
@@ -112,9 +126,34 @@ def main() -> int:
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
 
+    # optional lease-based leader election for multi-replica deployments
+    # (reference: cmd/main.go:74-76; off by default, like the reference flag)
+    elector = None
+    if env_bool("LEADER_ELECT"):
+        import socket
+
+        from inferno_tpu.controller.leader import LeaderElector
+
+        # the lease lives in the pod's own namespace (downward-API
+        # POD_NAMESPACE; that's where the RBAC Role grants lease access),
+        # like controller-runtime's default
+        elector = LeaderElector(
+            kube=kube,
+            identity=f"{socket.gethostname()}_{os.getpid()}",
+            namespace=os.environ.get("POD_NAMESPACE", "")
+            or getattr(kube, "namespace", "")
+            or config.config_namespace,
+        )
+        elector.start()
+
     try:
-        rec.run_forever(stop_check=lambda: stopping["stop"])
+        rec.run_forever(
+            stop_check=lambda: stopping["stop"],
+            gate=(elector.is_leader if elector else (lambda: True)),
+        )
     finally:
+        if elector:
+            elector.stop()
         health.stop()
         server.stop()
     return 0

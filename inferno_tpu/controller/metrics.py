@@ -132,11 +132,47 @@ class MetricsEmitter:
             self.scaling_total.inc({**labels, LABEL_DIRECTION: direction})
 
 
+class TLSConfig:
+    """Serve-side TLS with cert reload (the reference uses certwatchers on
+    its metrics endpoint, cmd/main.go:122-199). Certs are re-read when the
+    file mtime changes — rotation (cert-manager, service CA) needs no
+    restart."""
+
+    def __init__(self, cert_file: str, key_file: str, min_version=None):
+        import ssl
+
+        self.cert_file = cert_file
+        self.key_file = key_file
+        self._mtime = 0.0
+        self.ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        self.ctx.minimum_version = min_version or ssl.TLSVersion.TLSv1_2
+        self.maybe_reload()
+
+    def maybe_reload(self) -> None:
+        import os
+
+        try:
+            mtime = max(os.path.getmtime(self.cert_file), os.path.getmtime(self.key_file))
+        except OSError:
+            return
+        if mtime > self._mtime:
+            self.ctx.load_cert_chain(self.cert_file, self.key_file)
+            self._mtime = mtime
+
+    @classmethod
+    def from_env(cls) -> "TLSConfig | None":
+        import os
+
+        cert = os.environ.get("METRICS_TLS_CERT_PATH", "")
+        key = os.environ.get("METRICS_TLS_KEY_PATH", "")
+        return cls(cert, key) if cert and key else None
+
+
 class _RouteServer:
-    """Threaded HTTP listener serving a map of path -> () -> (code,
+    """Threaded HTTP(S) listener serving a map of path -> () -> (code,
     content-type, body)."""
 
-    def __init__(self, routes: dict, port: int, host: str = ""):
+    def __init__(self, routes: dict, port: int, host: str = "", tls: TLSConfig | None = None):
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 route = routes.get(self.path)
@@ -152,6 +188,29 @@ class _RouteServer:
                 pass
 
         self.httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.tls = tls
+        if tls is not None:
+            # TLS handshake happens in the per-connection thread, never on
+            # the accept loop: a client that connects and stays silent must
+            # not block every other scrape/probe. Certs are re-checked per
+            # connection, so rotation needs no restart.
+            httpd = self.httpd
+            plain_thread = type(httpd).process_request_thread
+
+            def process_request_thread(request, client_address):
+                import ssl as _ssl
+
+                try:
+                    tls.maybe_reload()
+                    request.settimeout(10)  # bound the handshake
+                    request = tls.ctx.wrap_socket(request, server_side=True)
+                    request.settimeout(None)
+                except (OSError, _ssl.SSLError):
+                    httpd.shutdown_request(request)
+                    return
+                plain_thread(httpd, request, client_address)
+
+            httpd.process_request_thread = process_request_thread
         self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
     @property
@@ -187,7 +246,13 @@ class MetricsServer(_RouteServer):
     """Serves /metrics (plus the probe routes, for single-port setups) on
     a background thread."""
 
-    def __init__(self, registry: Registry, port: int = 8443, host: str = ""):
+    def __init__(
+        self,
+        registry: Registry,
+        port: int = 8443,
+        host: str = "",
+        tls: TLSConfig | None = None,
+    ):
         self.registry = registry
         self.ready_flag = {"ready": True}
 
@@ -195,4 +260,4 @@ class MetricsServer(_RouteServer):
             return (200, "text/plain; version=0.0.4", registry.render().encode())
 
         routes = {"/metrics": metrics, **_probe_routes(self.ready_flag)}
-        super().__init__(routes, port, host)
+        super().__init__(routes, port, host, tls=tls)

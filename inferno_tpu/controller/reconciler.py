@@ -50,6 +50,7 @@ from inferno_tpu.controller.crd import (
     _utcnow,
 )
 from inferno_tpu.controller.engines import EngineMetrics, engine_for
+from inferno_tpu.controller.inventory import collect_tpu_inventory
 from inferno_tpu.controller.kube import KubeClient, KubeError, NotFound
 from inferno_tpu.controller.promclient import PromClient, PromError
 from inferno_tpu.core import System
@@ -107,6 +108,8 @@ class Reconciler:
     ):
         from inferno_tpu.controller.metrics import MetricsEmitter
 
+        from inferno_tpu.controller.logger import get_logger
+
         self.kube = kube
         self.prom = prom
         self.config = config or ReconcilerConfig()
@@ -114,6 +117,7 @@ class Reconciler:
         self.actuator = Actuator(
             kube=kube, emitter=self.emitter, direct_scale=self.config.direct_scale
         )
+        self.log = get_logger("inferno.reconciler")
 
     # -- config reading -----------------------------------------------------
 
@@ -199,6 +203,17 @@ class Reconciler:
                 )
             except (json.JSONDecodeError, ValueError, AttributeError):
                 pass
+        if not optimizer.unlimited and not capacity.chips:
+            # limited mode with no static capacity: discover chip pools from
+            # node google.com/tpu resources (inventory.py); an inventory
+            # failure leaves capacity empty, and the greedy solver then has
+            # nothing to assign — safer than inventing capacity, but it must
+            # be visible in the logs
+            try:
+                capacity = collect_tpu_inventory(self.kube)
+            except KubeError:
+                self.log.exception("TPU inventory discovery failed; "
+                                   "limited mode has no capacity this cycle")
         return optimizer, capacity
 
     # -- per-VA preparation -------------------------------------------------
@@ -447,9 +462,30 @@ class Reconciler:
             except KubeError as e:
                 report.errors.append(f"{va.full_name}: status: {e}")
 
-    def run_forever(self, stop_check=lambda: False) -> None:
+    def run_forever(self, stop_check=lambda: False, gate=lambda: True) -> None:
         """Interval-driven steady state (the reference uses RequeueAfter,
-        controller.go:201)."""
+        controller.go:201). `gate` is the leadership check: a non-leader
+        idles without reconciling (reference: manager suspends controllers
+        until elected)."""
+        import logging
+
+        from inferno_tpu.controller.logger import kv
+
         while not stop_check():
+            if not gate():
+                time.sleep(1)
+                continue
             report = self.run_cycle()
+            kv(
+                self.log,
+                logging.ERROR if not report.optimization_ok else logging.INFO,
+                "cycle",
+                variants_seen=report.variants_seen,
+                variants_prepared=report.variants_prepared,
+                variants_applied=report.variants_applied,
+                optimization_ok=report.optimization_ok,
+                analysis_ms=round(report.analysis_ms, 3),
+                solver_ms=round(report.solver_ms, 3),
+                errors=report.errors,
+            )
             time.sleep(max(report.interval_seconds, 1))

@@ -1,0 +1,91 @@
+"""TPU node inventory -> limited-mode capacity (the reference's
+CollectInventoryK8S stub made real, collector.go:23-42)."""
+
+from inferno_tpu.controller.inventory import (
+    collect_tpu_inventory,
+    generation_of,
+    node_tpu_chips,
+)
+from inferno_tpu.controller.kube import InMemoryCluster
+from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+
+from test_controller import CFG_NS, NS, make_cluster, make_prom
+
+
+def test_inventory_sums_chips_per_generation():
+    cluster = InMemoryCluster()
+    cluster.add_node("n1", tpu_chips=4, accelerator="tpu-v5-lite-podslice")
+    cluster.add_node("n2", tpu_chips=4, accelerator="tpu-v5-lite-podslice")
+    cluster.add_node("n3", tpu_chips=4, accelerator="tpu-v5p-slice")
+    cluster.add_node("cpu-only")  # no TPU resource
+    cluster.add_node("cordoned", tpu_chips=4, accelerator="tpu-v5-lite-podslice",
+                     unschedulable=True)
+    cap = collect_tpu_inventory(cluster)
+    assert cap.chips == {"v5e": 8, "v5p": 4}
+
+
+def test_unknown_accelerator_label_passes_through():
+    node = {"metadata": {"labels": {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v7x-slice"}}}
+    assert generation_of(node) == "tpu-v7x-slice"
+    assert generation_of({"metadata": {"labels": {}}}) is None
+
+
+def test_chips_fall_back_to_capacity_field():
+    node = {"status": {"capacity": {"google.com/tpu": "8"}}}
+    assert node_tpu_chips(node) == 8
+    assert node_tpu_chips({"status": {}}) == 0
+
+
+def test_limited_mode_uses_discovered_capacity():
+    cluster = make_cluster(replicas=1)
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {
+        "GLOBAL_OPT_INTERVAL": "30s",
+        "OPTIMIZER_MODE": "limited",
+        # best-effort under saturation, else an unsatisfiable demand gets
+        # nothing rather than the capacity-capped allocation
+        "SATURATION_POLICY": "PriorityExhaustive",
+    })
+    # enough v5e chips for a few 4-chip replicas
+    for i in range(3):
+        cluster.add_node(f"tpu-{i}", tpu_chips=4, accelerator="tpu-v5-lite-podslice")
+    rec = Reconciler(kube=cluster, prom=make_prom(arrival_rps=50.0),
+                     config=ReconcilerConfig(config_namespace=CFG_NS,
+                                             compute_backend="scalar"))
+    optimizer, capacity = rec.read_optimizer_and_capacity()
+    assert not optimizer.unlimited
+    assert capacity.chips == {"v5e": 12}
+
+    rec.run_cycle()
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    d = va.status.desired_optimized_alloc
+    # demand wants ~9 replicas (see test_cycle_scales_out_under_load) but
+    # 12 chips cap v5e-4 at 3 pod-slices
+    assert d.accelerator == "v5e-4"
+    assert d.num_replicas == 3
+
+
+def test_static_capacity_wins_over_inventory():
+    cluster = make_cluster(replicas=1)
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {
+        "GLOBAL_OPT_INTERVAL": "30s",
+        "OPTIMIZER_MODE": "limited",
+        "TPU_CAPACITY": '{"v5e": 64}',
+    })
+    cluster.add_node("tpu-0", tpu_chips=4, accelerator="tpu-v5-lite-podslice")
+    rec = Reconciler(kube=cluster, prom=make_prom(),
+                     config=ReconcilerConfig(config_namespace=CFG_NS,
+                                             compute_backend="scalar"))
+    _, capacity = rec.read_optimizer_and_capacity()
+    assert capacity.chips == {"v5e": 64}
+
+
+def test_unlimited_mode_skips_inventory():
+    cluster = make_cluster(replicas=1)
+    cluster.add_node("tpu-0", tpu_chips=4, accelerator="tpu-v5-lite-podslice")
+    rec = Reconciler(kube=cluster, prom=make_prom(),
+                     config=ReconcilerConfig(config_namespace=CFG_NS,
+                                             compute_backend="scalar"))
+    optimizer, capacity = rec.read_optimizer_and_capacity()
+    assert optimizer.unlimited
+    assert capacity.chips == {}

@@ -1,0 +1,126 @@
+"""Lease-based leader election (reference: controller-runtime election,
+cmd/main.go:74-76,206-207)."""
+
+import time
+
+import pytest
+
+from inferno_tpu.controller.kube import Conflict, InMemoryCluster, NotFound
+from inferno_tpu.controller.leader import LeaderElector
+
+NS = "inferno-system"
+
+
+def elector(cluster, identity, **kw):
+    # leaseDurationSeconds serializes in whole seconds, so test timings
+    # run at 1s scale
+    kw.setdefault("lease_duration", 1.0)
+    kw.setdefault("renew_deadline", 0.8)
+    kw.setdefault("retry_period", 0.05)
+    return LeaderElector(kube=cluster, identity=identity, namespace=NS, **kw)
+
+
+def test_first_candidate_acquires():
+    cluster = InMemoryCluster()
+    a = elector(cluster, "a")
+    assert a.try_acquire_or_renew()
+    assert a.is_leader()
+    lease = cluster.get_lease(NS, a.lease_name)
+    assert lease["spec"]["holderIdentity"] == "a"
+
+
+def test_second_candidate_blocked_while_held():
+    cluster = InMemoryCluster()
+    a, b = elector(cluster, "a"), elector(cluster, "b")
+    assert a.try_acquire_or_renew()
+    assert not b.try_acquire_or_renew()
+    assert not b.is_leader()
+
+
+def test_takeover_after_expiry():
+    cluster = InMemoryCluster()
+    a, b = elector(cluster, "a"), elector(cluster, "b")
+    assert a.try_acquire_or_renew()
+    time.sleep(1.1)  # past lease_duration without renewal
+    assert b.try_acquire_or_renew()
+    assert b.is_leader()
+    lease = cluster.get_lease(NS, b.lease_name)
+    assert lease["spec"]["holderIdentity"] == "b"
+    assert lease["spec"]["leaseTransitions"] == 1
+    # the stale holder observes the loss on its next round
+    assert not a.try_acquire_or_renew()
+    assert not a.is_leader()
+
+
+def test_renewal_keeps_leadership():
+    cluster = InMemoryCluster()
+    a, b = elector(cluster, "a"), elector(cluster, "b")
+    assert a.try_acquire_or_renew()
+    for _ in range(3):
+        time.sleep(0.4)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+    assert a.is_leader()
+
+
+def test_leadership_lapses_without_renewal():
+    cluster = InMemoryCluster()
+    a = elector(cluster, "a")
+    assert a.try_acquire_or_renew()
+    time.sleep(0.85)  # past renew_deadline
+    assert not a.is_leader()
+
+
+def test_conflict_race_yields_not_leader():
+    cluster = InMemoryCluster()
+    a = elector(cluster, "a")
+    assert a.try_acquire_or_renew()
+    time.sleep(1.1)
+
+    b, c = elector(cluster, "b"), elector(cluster, "c")
+    # c wins the race between b's read and write: b's stale-rv update conflicts
+    lease_for_b = cluster.get_lease(NS, b.lease_name)
+    assert c.try_acquire_or_renew()
+    orig_get = cluster.get_lease
+    cluster.get_lease = lambda ns, name: lease_for_b
+    try:
+        assert not b.try_acquire_or_renew()
+    finally:
+        cluster.get_lease = orig_get
+    assert cluster.get_lease(NS, b.lease_name)["spec"]["holderIdentity"] == "c"
+
+
+def test_voluntary_release_enables_immediate_takeover():
+    cluster = InMemoryCluster()
+    a, b = elector(cluster, "a"), elector(cluster, "b")
+    assert a.try_acquire_or_renew()
+    a.stop(release=True)
+    assert b.try_acquire_or_renew()
+    assert b.is_leader()
+
+
+def test_background_loop_and_gate():
+    cluster = InMemoryCluster()
+    a = elector(cluster, "a")
+    a.start()
+    deadline = time.time() + 2
+    while not a.is_leader() and time.time() < deadline:
+        time.sleep(0.02)
+    assert a.is_leader()
+    a.stop()
+    assert not a.is_leader()
+
+
+def test_inmemory_lease_optimistic_concurrency():
+    cluster = InMemoryCluster()
+    with pytest.raises(NotFound):
+        cluster.get_lease(NS, "x")
+    created = cluster.create_lease(NS, "x", {"spec": {"holderIdentity": "a"}})
+    assert created["metadata"]["resourceVersion"] == "1"
+    with pytest.raises(Conflict):
+        cluster.create_lease(NS, "x", {"spec": {}})
+    stale = dict(created)
+    updated = cluster.update_lease(NS, "x", created)
+    assert updated["metadata"]["resourceVersion"] == "2"
+    with pytest.raises(Conflict):
+        cluster.update_lease(NS, "x", stale)

@@ -1,0 +1,85 @@
+"""TLS on the controller metrics endpoint with cert rotation
+(reference certwatchers: cmd/main.go:122-199)."""
+
+import ssl
+import subprocess
+import urllib.error
+import urllib.request
+
+import pytest
+
+from inferno_tpu.controller.metrics import MetricsServer, Registry, TLSConfig
+
+
+def make_cert(tmp_path, name, cn="localhost"):
+    cert = tmp_path / f"{name}.crt"
+    key = tmp_path / f"{name}.key"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(cert), "-days", "1",
+            "-subj", f"/CN={cn}",
+            "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return str(cert), str(key)
+
+
+@pytest.fixture()
+def tls_server(tmp_path):
+    from inferno_tpu.controller.metrics import MetricsEmitter
+
+    cert, key = make_cert(tmp_path, "srv")
+    registry = Registry()
+    MetricsEmitter(registry).emit_replica_metrics(
+        variant="v", namespace="ns", accelerator="v5e-4", current=1, desired=2
+    )
+    server = MetricsServer(registry, port=0, tls=TLSConfig(cert, key))
+    server.start()
+    yield server, cert, key, tmp_path
+    server.stop()
+
+
+def _fetch(port, cafile):
+    ctx = ssl.create_default_context(cafile=cafile)
+    with urllib.request.urlopen(
+        f"https://localhost:{port}/metrics", context=ctx, timeout=10
+    ) as resp:
+        return resp.read().decode()
+
+
+def test_metrics_served_over_tls(tls_server):
+    server, cert, _, _ = tls_server
+    body = _fetch(server.port, cert)
+    assert "inferno_desired_replicas" in body
+
+
+def test_plain_http_rejected(tls_server):
+    server, *_ = tls_server
+    with pytest.raises(Exception):
+        urllib.request.urlopen(f"http://localhost:{server.port}/metrics", timeout=5)
+
+
+def test_cert_rotation_without_restart(tls_server):
+    server, cert, key, tmp_path = tls_server
+    _fetch(server.port, cert)
+    # rotate: overwrite cert+key in place with a fresh pair
+    new_cert, new_key = make_cert(tmp_path, "rotated")
+    import os
+    import shutil
+    import time
+
+    shutil.copy(new_cert, cert)
+    shutil.copy(new_key, key)
+    future = time.time() + 2
+    os.utime(cert, (future, future))
+    os.utime(key, (future, future))
+    body = _fetch(server.port, new_cert)  # must validate against the NEW cert
+    assert "inferno_desired_replicas" in body
+    # an unrelated CA no longer matches what the server presents, proving
+    # verification actually ran above (urllib wraps the SSL failure)
+    other_cert, _ = make_cert(tmp_path, "other")
+    with pytest.raises((ssl.SSLError, urllib.error.URLError)):
+        _fetch(server.port, other_cert)
