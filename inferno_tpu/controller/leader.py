@@ -66,6 +66,12 @@ class LeaderElector:
         self._last_renew: float = 0.0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # last observed (holder, renewTime) and the local monotonic time of
+        # that observation — expiry is judged from OUR clock, like
+        # client-go, so holder clock skew cannot cause a spurious takeover
+        self._observed: tuple[str, str] | None = None
+        self._observed_at: float = 0.0
+        self._fail_reported = False
 
     # -- leadership state ----------------------------------------------------
 
@@ -96,9 +102,10 @@ class LeaderElector:
             lease = self.kube.get_lease(self.namespace, self.lease_name)
         except NotFound:
             lease = None
-        except (KubeError, OSError):
+        except (KubeError, OSError) as e:
             # OSError covers connection-level failures (URLError, timeouts)
             # that bypass the HTTP error mapping
+            self._note_failure("lease read failed", e)
             return self._lost()
 
         try:
@@ -110,9 +117,20 @@ class LeaderElector:
 
             spec = lease.get("spec", {}) or {}
             holder = spec.get("holderIdentity", "")
-            renew = _parse(spec.get("renewTime", ""))
+            renew_raw = spec.get("renewTime", "")
             duration = float(spec.get("leaseDurationSeconds", self.lease_duration))
-            expired = renew is None or (_now() - renew).total_seconds() > duration
+            # clock-skew-safe expiry: the lease is expired when WE have
+            # observed the same (holder, renewTime) for longer than the
+            # duration — the holder's wall clock is never trusted
+            observation = (holder, renew_raw)
+            if observation != self._observed:
+                self._observed = observation
+                self._observed_at = time.monotonic()
+            expired = (
+                not renew_raw
+                or _parse(renew_raw) is None
+                or time.monotonic() - self._observed_at > duration
+            )
 
             if holder == self.identity:
                 new_spec = dict(spec)
@@ -122,22 +140,37 @@ class LeaderElector:
                 self.kube.update_lease(self.namespace, self.lease_name, lease)
                 return self._won()
 
-            if expired:
+            if not holder or expired:
+                # empty holder = voluntarily released; acquirable at once
                 transitions = int(spec.get("leaseTransitions", 0)) + 1
                 lease["spec"] = self._spec(transitions)
                 self.kube.update_lease(self.namespace, self.lease_name, lease)
                 return self._won()
 
             return self._lost()
-        except (Conflict, KubeError, OSError):
-            # another candidate raced us, or the API server is unreachable;
-            # observe again next round
+        except Conflict:
+            # another candidate raced us; observe again next round
             return self._lost()
+        except (KubeError, OSError) as e:
+            # persistent write failures (e.g. RBAC Forbidden) must be
+            # visible: a silent non-leader gates reconciliation forever
+            self._note_failure("lease write failed", e)
+            return self._lost()
+
+    def _note_failure(self, what: str, err: Exception) -> None:
+        if not self._fail_reported:
+            from inferno_tpu.controller.logger import get_logger
+
+            get_logger("inferno.leader").warning(
+                "%s for %s/%s: %s", what, self.namespace, self.lease_name, err
+            )
+            self._fail_reported = True
 
     def _won(self) -> bool:
         if self._held_since is None:
             self._held_since = time.monotonic()
         self._last_renew = time.monotonic()
+        self._fail_reported = False
         return True
 
     def _lost(self) -> bool:
@@ -171,17 +204,16 @@ class LeaderElector:
         if self._thread:
             self._thread.join(timeout=5)
         if release and self._held_since is not None:
-            # voluntary hand-off: zero the renew time so the next candidate
-            # can take over immediately instead of waiting out the lease
+            # voluntary hand-off: clear the holder (client-go's release
+            # semantics) so the next candidate can take over immediately
+            # instead of waiting out the lease
             try:
                 lease = self.kube.get_lease(self.namespace, self.lease_name)
                 spec = lease.get("spec", {}) or {}
                 if spec.get("holderIdentity") == self.identity:
-                    spec["renewTime"] = _fmt(
-                        _now() - datetime.timedelta(seconds=self.lease_duration + 1)
-                    )
+                    spec["holderIdentity"] = ""
                     lease["spec"] = spec
                     self.kube.update_lease(self.namespace, self.lease_name, lease)
-            except KubeError:
-                pass
+            except (KubeError, OSError):
+                pass  # shutdown must not raise; the lease just times out
         self._held_since = None

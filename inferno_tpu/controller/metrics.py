@@ -146,18 +146,26 @@ class TLSConfig:
         self._mtime = 0.0
         self.ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         self.ctx.minimum_version = min_version or ssl.TLSVersion.TLSv1_2
-        self.maybe_reload()
+        # fail fast: a bad cert path would otherwise black-hole every
+        # scrape with no diagnostic (wrap_socket failures are per-conn)
+        self.ctx.load_cert_chain(cert_file, key_file)
+        self._mtime = self._files_mtime()
 
-    def maybe_reload(self) -> None:
+    def _files_mtime(self) -> float:
         import os
 
+        return max(os.path.getmtime(self.cert_file), os.path.getmtime(self.key_file))
+
+    def maybe_reload(self) -> None:
         try:
-            mtime = max(os.path.getmtime(self.cert_file), os.path.getmtime(self.key_file))
+            mtime = self._files_mtime()
+            if mtime > self._mtime:
+                self.ctx.load_cert_chain(self.cert_file, self.key_file)
+                self._mtime = mtime
         except OSError:
+            # mid-rotation race (files briefly absent): keep serving the
+            # previously loaded certs and retry on the next connection
             return
-        if mtime > self._mtime:
-            self.ctx.load_cert_chain(self.cert_file, self.key_file)
-            self._mtime = mtime
 
     @classmethod
     def from_env(cls) -> "TLSConfig | None":

@@ -211,7 +211,9 @@ class Reconciler:
             # be visible in the logs
             try:
                 capacity = collect_tpu_inventory(self.kube)
-            except KubeError:
+            except (KubeError, OSError):
+                # OSError: connection-level failures (URLError) bypass the
+                # HTTP error mapping in the REST client
                 self.log.exception("TPU inventory discovery failed; "
                                    "limited mode has no capacity this cycle")
         return optimizer, capacity

@@ -37,12 +37,23 @@ def test_second_candidate_blocked_while_held():
     assert not b.is_leader()
 
 
+def _poll_until_leader(e, timeout=3.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if e.try_acquire_or_renew():
+            return True
+        time.sleep(0.05)
+    return False
+
+
 def test_takeover_after_expiry():
     cluster = InMemoryCluster()
     a, b = elector(cluster, "a"), elector(cluster, "b")
     assert a.try_acquire_or_renew()
-    time.sleep(1.1)  # past lease_duration without renewal
-    assert b.try_acquire_or_renew()
+    # expiry is judged from the observer's clock (clock-skew safe): b must
+    # watch the same unrenewed (holder, renewTime) for a full duration
+    assert not b.try_acquire_or_renew()
+    assert _poll_until_leader(b)
     assert b.is_leader()
     lease = cluster.get_lease(NS, b.lease_name)
     assert lease["spec"]["holderIdentity"] == "b"
@@ -72,19 +83,24 @@ def test_leadership_lapses_without_renewal():
 
 
 def test_conflict_race_yields_not_leader():
+    """b races c for an expired lease and loses: b's write carries the
+    resourceVersion of the lease it read before c's takeover, so the
+    optimistic-concurrency check rejects it and b stays a non-leader."""
     cluster = InMemoryCluster()
     a = elector(cluster, "a")
     assert a.try_acquire_or_renew()
-    time.sleep(1.1)
+    stale = cluster.get_lease(NS, a.lease_name)  # rv as of a's acquisition
 
     b, c = elector(cluster, "b"), elector(cluster, "c")
-    # c wins the race between b's read and write: b's stale-rv update conflicts
-    lease_for_b = cluster.get_lease(NS, b.lease_name)
-    assert c.try_acquire_or_renew()
+    assert _poll_until_leader(c)  # bumps the rv past the stale copy
+
+    # b's reads are frozen at the pre-takeover lease: it sees holder a,
+    # unrenewed, waits out the duration, then writes with the stale rv
     orig_get = cluster.get_lease
-    cluster.get_lease = lambda ns, name: lease_for_b
+    cluster.get_lease = lambda ns, name: dict(stale)
     try:
-        assert not b.try_acquire_or_renew()
+        assert not _poll_until_leader(b, timeout=2.0)
+        assert not b.is_leader()
     finally:
         cluster.get_lease = orig_get
     assert cluster.get_lease(NS, b.lease_name)["spec"]["holderIdentity"] == "c"
