@@ -78,11 +78,25 @@ class InMemoryCluster:
         self._configmaps: dict[tuple[str, str], dict[str, str]] = {}
         self._nodes: dict[str, dict] = {}
         self._leases: dict[tuple[str, str], dict] = {}
+        # (kind, event_type, namespace, name) subscribers (watch analogue)
+        self._subscribers: list = []
+
+    def subscribe(self, callback) -> None:
+        """Register `callback(kind, event_type, namespace, name)` for
+        resource events — the in-process analogue of API-server watches."""
+        self._subscribers.append(callback)
+
+    def _notify(self, kind: str, event_type: str, namespace: str, name: str) -> None:
+        for cb in self._subscribers:
+            cb(kind, event_type, namespace, name)
 
     # seeding helpers -------------------------------------------------------
 
     def add_variant_autoscaling(self, va: VariantAutoscaling) -> None:
-        self._vas[(va.namespace, va.name)] = va.to_dict()
+        key = (va.namespace, va.name)
+        event = "MODIFIED" if key in self._vas else "ADDED"
+        self._vas[key] = va.to_dict()
+        self._notify("VariantAutoscaling", event, va.namespace, va.name)
 
     def add_deployment(
         self, namespace: str, name: str, replicas: int = 1, labels: dict | None = None
@@ -94,7 +108,9 @@ class InMemoryCluster:
         }
 
     def set_configmap(self, namespace: str, name: str, data: dict[str, str]) -> None:
+        event = "MODIFIED" if (namespace, name) in self._configmaps else "ADDED"
         self._configmaps[(namespace, name)] = dict(data)
+        self._notify("ConfigMap", event, namespace, name)
 
     def delete_variant_autoscaling(self, namespace: str, name: str) -> None:
         self._vas.pop((namespace, name), None)
@@ -365,6 +381,15 @@ class RestKubeClient:
     def list_nodes(self) -> list[dict]:
         out = with_backoff(lambda: self._request("GET", "/api/v1/nodes"))
         return list(out.get("items", []) or [])
+
+    def watch_request(self, path: str) -> urllib.request.Request:
+        """An authenticated streaming request for `?watch=true` paths
+        (consumed line-by-line by controller.watch.Watcher)."""
+        req = urllib.request.Request(self.base_url + path)
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        return req
 
     def _lease_path(self, namespace: str, name: str = "") -> str:
         p = f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"

@@ -122,6 +122,7 @@ def main() -> int:
 
     def _stop(_sig, _frm):
         stopping["stop"] = True
+        rec.poke()  # wake the loop so shutdown doesn't wait out the interval
 
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
@@ -146,12 +147,20 @@ def main() -> int:
         )
         elector.start()
 
+    # event-driven triggers: VA creation and ConfigMap edits wake the loop
+    # early (reference: watch config, controller.go:456-487)
+    from inferno_tpu.controller.watch import Watcher
+
+    watcher = Watcher(kube, rec.poke, config_namespace=config.config_namespace)
+    watcher.start()
+
     try:
         rec.run_forever(
             stop_check=lambda: stopping["stop"],
             gate=(elector.is_leader if elector else (lambda: True)),
         )
     finally:
+        watcher.stop()
         if elector:
             elector.stop()
         health.stop()

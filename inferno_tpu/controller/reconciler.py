@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 import time
 from typing import Any
 
@@ -118,6 +119,12 @@ class Reconciler:
             kube=kube, emitter=self.emitter, direct_scale=self.config.direct_scale
         )
         self.log = get_logger("inferno.reconciler")
+        # set by a Watcher (or anyone) to trigger the next cycle early
+        self._wake = threading.Event()
+
+    def poke(self) -> None:
+        """Request an immediate reconcile (watch-event trigger)."""
+        self._wake.set()
 
     # -- config reading -----------------------------------------------------
 
@@ -490,4 +497,7 @@ class Reconciler:
                 solver_ms=round(report.solver_ms, 3),
                 errors=report.errors,
             )
-            time.sleep(max(report.interval_seconds, 1))
+            # interval sleep, interruptible by watch events (reference:
+            # RequeueAfter steady state + create/ConfigMap triggers)
+            self._wake.wait(max(report.interval_seconds, 1))
+            self._wake.clear()

@@ -1,0 +1,157 @@
+"""Event-driven reconcile triggers.
+
+The reference controller is not purely interval-driven: reconciles are
+triggered by VariantAutoscaling *create* events (update/delete filtered
+out) and by edits to the named ConfigMaps, with steady state handled by
+RequeueAfter (/root/reference/internal/controller/
+variantautoscaling_controller.go:456-487). This module reproduces that:
+a `Watcher` wakes the reconcile loop early when
+
+* a VariantAutoscaling is ADDED (a new variant should not wait out the
+  rest of a 60s interval before its first sizing), or
+* one of the controller ConfigMaps changes (config edits apply at once).
+
+Two transports:
+* in-process subscription when the kube client offers `subscribe`
+  (InMemoryCluster) — used by tests and the emulated stack;
+* Kubernetes watch streams (`?watch=true`, JSON-lines) against the real
+  API server, with automatic reconnect and jittered backoff.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Callable
+
+from inferno_tpu.controller.crd import GROUP, PLURAL, VERSION
+
+WATCHED_CONFIGMAPS = (
+    "inferno-autoscaler-config",
+    "accelerator-unit-costs",
+    "service-classes-config",
+)
+
+
+class Watcher:
+    """Wakes `wake()` on VA creation and watched-ConfigMap changes."""
+
+    def __init__(self, kube, wake: Callable[[], None], config_namespace: str):
+        self.kube = kube
+        self.wake = wake
+        self.config_namespace = config_namespace
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- event filtering (reference parity) ----------------------------------
+
+    def _on_va_event(self, event_type: str) -> None:
+        # create-only, like the reference's event filter (controller.go:473-486)
+        if event_type == "ADDED":
+            self.wake()
+
+    def _on_cm_event(self, name: str, namespace: str) -> None:
+        if namespace == self.config_namespace and name in WATCHED_CONFIGMAPS:
+            self.wake()
+
+    # -- in-process transport ------------------------------------------------
+
+    def _subscribe_local(self) -> bool:
+        subscribe = getattr(self.kube, "subscribe", None)
+        if subscribe is None:
+            return False
+
+        def on_event(kind: str, event_type: str, namespace: str, name: str):
+            if kind == "VariantAutoscaling":
+                self._on_va_event(event_type)
+            elif kind == "ConfigMap":
+                self._on_cm_event(name, namespace)
+
+        subscribe(on_event)
+        return True
+
+    # -- API-server watch streams --------------------------------------------
+
+    def _stream(self, base_path: str, handle) -> None:
+        """List-then-watch with reconnect, tracking resourceVersion so a
+        reconnect resumes where the stream left off instead of replaying
+        every existing object as a synthetic ADDED (which would defeat
+        the create-only filter at each server-side timeout)."""
+        import http.client
+
+        backoff = 1.0
+        rv: str | None = None
+        while not self._stop.is_set():
+            try:
+                if rv is None:
+                    # list to learn the current resourceVersion; the watch
+                    # then starts "now", with no initial replay burst
+                    req = self.kube.watch_request(base_path)
+                    with urllib.request.urlopen(
+                        req, context=self.kube.ctx, timeout=30
+                    ) as resp:
+                        body = json.loads(resp.read())
+                    rv = str((body.get("metadata") or {}).get("resourceVersion") or "")
+                path = f"{base_path}?watch=true&timeoutSeconds=300"
+                if rv:
+                    path += f"&resourceVersion={rv}"
+                req = self.kube.watch_request(path)
+                with urllib.request.urlopen(
+                    req, context=self.kube.ctx, timeout=330
+                ) as resp:
+                    backoff = 1.0
+                    for line in resp:
+                        if self._stop.is_set():
+                            return
+                        if not line.strip():
+                            continue
+                        try:
+                            evt = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        if evt.get("type") == "ERROR":
+                            rv = None  # e.g. 410 Gone: relist and resume
+                            break
+                        meta = (evt.get("object") or {}).get("metadata") or {}
+                        new_rv = meta.get("resourceVersion")
+                        if new_rv:
+                            rv = str(new_rv)
+                        try:
+                            handle(evt)
+                        except (KeyError, TypeError):
+                            continue
+            except (OSError, http.client.HTTPException, json.JSONDecodeError):
+                # connection-level and mid-stream failures (IncompleteRead
+                # is an HTTPException, not an OSError) both just reconnect
+                pass
+            self._stop.wait(backoff)
+            backoff = min(backoff * 2, 30.0)
+
+    def _run_va_stream(self) -> None:
+        def handle(evt: dict) -> None:
+            self._on_va_event(evt.get("type", ""))
+
+        self._stream(f"/apis/{GROUP}/{VERSION}/{PLURAL}", handle)
+
+    def _run_cm_stream(self) -> None:
+        def handle(evt: dict) -> None:
+            meta = (evt.get("object", {}) or {}).get("metadata", {}) or {}
+            self._on_cm_event(meta.get("name", ""), meta.get("namespace", ""))
+
+        self._stream(f"/api/v1/namespaces/{self.config_namespace}/configmaps", handle)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._subscribe_local():
+            return
+        if not hasattr(self.kube, "watch_request"):
+            return  # client offers neither transport; interval-only
+        for target in (self._run_va_stream, self._run_cm_stream):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,177 @@
+"""Event-driven reconcile triggers (reference watch config:
+variantautoscaling_controller.go:456-487 — VA create-only + named
+ConfigMaps)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from inferno_tpu.controller.kube import InMemoryCluster
+from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+from inferno_tpu.controller.watch import WATCHED_CONFIGMAPS, Watcher
+
+from test_controller import CFG_NS, make_cluster, make_prom
+
+
+def test_va_create_wakes_update_does_not():
+    cluster = InMemoryCluster()
+    woke = []
+    w = Watcher(cluster, lambda: woke.append(1), config_namespace=CFG_NS)
+    w.start()
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {"k": "v"})
+    assert len(woke) == 1  # watched ConfigMap created
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {"k": "v2"})
+    assert len(woke) == 2  # and modified
+    cluster.set_configmap(CFG_NS, "unrelated-cm", {"k": "v"})
+    assert len(woke) == 2  # unrelated ConfigMap ignored
+    cluster.set_configmap("elsewhere", "inferno-autoscaler-config", {"k": "v"})
+    assert len(woke) == 2  # right name, wrong namespace
+
+    from test_controller import make_cluster as _  # noqa: F401
+
+    from inferno_tpu.controller.crd import VariantAutoscaling, VariantAutoscalingSpec
+
+    va = VariantAutoscaling(name="x", namespace="ns",
+                            spec=VariantAutoscalingSpec(model_id="m"))
+    cluster.add_variant_autoscaling(va)
+    assert len(woke) == 3  # VA ADDED wakes
+    cluster.add_variant_autoscaling(va)
+    assert len(woke) == 3  # VA MODIFIED filtered (create-only, reference parity)
+    w.stop()
+
+
+def test_poke_interrupts_interval_sleep():
+    cluster = make_cluster(replicas=1)
+    # long interval: without the wake, the second cycle would be a minute out
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config",
+                          {"GLOBAL_OPT_INTERVAL": "60s"})
+    rec = Reconciler(kube=cluster, prom=make_prom(arrival_rps=5.0),
+                     config=ReconcilerConfig(config_namespace=CFG_NS,
+                                             compute_backend="scalar"))
+    cycles = []
+    orig = rec.run_cycle
+    rec.run_cycle = lambda: (cycles.append(time.time()), orig())[1]
+    stopping = {"stop": False}
+    t = threading.Thread(
+        target=lambda: rec.run_forever(stop_check=lambda: stopping["stop"])
+    )
+    t.start()
+    try:
+        deadline = time.time() + 2
+        while not cycles and time.time() < deadline:
+            time.sleep(0.02)
+        assert cycles, "first cycle never ran"
+        n = len(cycles)
+        rec.poke()
+        deadline = time.time() + 2
+        while len(cycles) <= n and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(cycles) > n, "poke did not trigger an early cycle"
+    finally:
+        # stop + poke, as main's signal handler does, so shutdown does not
+        # wait out the 60s interval
+        stopping["stop"] = True
+        rec.poke()
+        t.join(timeout=5)
+    assert not t.is_alive()
+
+
+class _StreamingWatchServer:
+    """Fake API server: answers the initial list (resourceVersion), then
+    streams watch events as JSON lines."""
+
+    def __init__(self, events):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                if "watch=true" not in self.path:
+                    outer.list_requests.append(self.path)
+                    self.wfile.write(
+                        json.dumps({"metadata": {"resourceVersion": "41"},
+                                    "items": []}).encode()
+                    )
+                    return
+                outer.watch_requests.append(self.path)
+                for evt in outer.events:
+                    self.wfile.write((json.dumps(evt) + "\n").encode())
+                    self.wfile.flush()
+                    time.sleep(0.02)
+                outer.done.set()
+                time.sleep(1)  # hold the stream open briefly
+
+            def log_message(self, *a):
+                pass
+
+        self.events = events
+        self.done = threading.Event()
+        self.list_requests: list[str] = []
+        self.watch_requests: list[str] = []
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+class _FakeRestKube:
+    """Just enough of RestKubeClient for the stream transport."""
+
+    def __init__(self, base_url):
+        self.base_url = base_url
+        self.ctx = None
+        self.token = ""
+
+    def watch_request(self, path: str):
+        import urllib.request
+
+        return urllib.request.Request(self.base_url + path)
+
+
+def test_http_watch_stream_wakes_on_va_added():
+    events = [
+        {"type": "ADDED", "object": {"kind": "VariantAutoscaling"}},
+        {"type": "MODIFIED", "object": {"kind": "VariantAutoscaling"}},
+        {"type": "ADDED", "object": {"kind": "VariantAutoscaling"}},
+    ]
+    srv = _StreamingWatchServer(events)
+    woke = []
+    w = Watcher(_FakeRestKube(f"http://127.0.0.1:{srv.port}"),
+                lambda: woke.append(1), config_namespace=CFG_NS)
+    # drive only the VA stream (the CM stream would hit the same fake)
+    t = threading.Thread(target=w._run_va_stream, daemon=True)
+    t.start()
+    assert srv.done.wait(5)
+    time.sleep(0.1)
+    w.stop()
+    srv.stop()
+    assert len(woke) == 2  # two ADDED, MODIFIED filtered
+    # list-then-watch: the watch carried the listed resourceVersion, so a
+    # reconnect would not replay existing objects as synthetic ADDEDs
+    assert srv.list_requests and "watch" not in srv.list_requests[0]
+    assert "resourceVersion=41" in srv.watch_requests[0]
+
+
+def test_http_watch_stream_wakes_on_watched_cm():
+    events = [
+        {"type": "MODIFIED", "object": {"kind": "ConfigMap", "metadata":
+            {"name": WATCHED_CONFIGMAPS[0], "namespace": CFG_NS}}},
+        {"type": "MODIFIED", "object": {"kind": "ConfigMap", "metadata":
+            {"name": "other", "namespace": CFG_NS}}},
+    ]
+    srv = _StreamingWatchServer(events)
+    woke = []
+    w = Watcher(_FakeRestKube(f"http://127.0.0.1:{srv.port}"),
+                lambda: woke.append(1), config_namespace=CFG_NS)
+    t = threading.Thread(target=w._run_cm_stream, daemon=True)
+    t.start()
+    assert srv.done.wait(5)
+    time.sleep(0.1)
+    w.stop()
+    srv.stop()
+    assert len(woke) == 1
