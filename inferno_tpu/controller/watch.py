@@ -22,16 +22,18 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.error
 import urllib.request
 from typing import Callable
 
 from inferno_tpu.controller.crd import GROUP, PLURAL, VERSION
-
-WATCHED_CONFIGMAPS = (
-    "inferno-autoscaler-config",
-    "accelerator-unit-costs",
-    "service-classes-config",
+from inferno_tpu.controller.reconciler import (
+    CM_ACCELERATOR_COSTS,
+    CM_CONFIG,
+    CM_SERVICE_CLASSES,
 )
+
+WATCHED_CONFIGMAPS = (CM_CONFIG, CM_ACCELERATOR_COSTS, CM_SERVICE_CLASSES)
 
 
 class Watcher:
@@ -93,7 +95,12 @@ class Watcher:
                     ) as resp:
                         body = json.loads(resp.read())
                     rv = str((body.get("metadata") or {}).get("resourceVersion") or "")
-                path = f"{base_path}?watch=true&timeoutSeconds=300"
+                # bookmarks keep rv fresh across quiet periods, so a
+                # reconnect rv is unlikely to be compaction-stale
+                path = (
+                    f"{base_path}?watch=true&timeoutSeconds=300"
+                    "&allowWatchBookmarks=true"
+                )
                 if rv:
                     path += f"&resourceVersion={rv}"
                 req = self.kube.watch_request(path)
@@ -117,10 +124,17 @@ class Watcher:
                         new_rv = meta.get("resourceVersion")
                         if new_rv:
                             rv = str(new_rv)
+                        if evt.get("type") == "BOOKMARK":
+                            continue  # rv refresh only, no user event
                         try:
                             handle(evt)
                         except (KeyError, TypeError):
                             continue
+            except urllib.error.HTTPError as e:
+                if e.code == 410:
+                    # compacted resourceVersion rejected at establishment
+                    # (not as an in-stream ERROR event): relist
+                    rv = None
             except (OSError, http.client.HTTPException, json.JSONDecodeError):
                 # connection-level and mid-stream failures (IncompleteRead
                 # is an HTTPException, not an OSError) both just reconnect

@@ -1,0 +1,99 @@
+"""ModelAnalyzer facade + thread-safety of the analysis stack.
+
+The reference's analyzer is explicitly thread-unsafe (package-global
+system singleton and eval state, SURVEY §5.2) and survives only because
+reconciles are serialized. This build's analyzers are immutable values —
+proven here by hammering the same sizing from many threads and requiring
+bit-identical results.
+"""
+
+import threading
+
+import pytest
+
+from inferno_tpu.analyzer import TargetPerf, build_analyzer
+from inferno_tpu.analyzer.queue import RequestSize
+from inferno_tpu.config.types import DecodeParms, PrefillParms
+from inferno_tpu.controller.modelanalyzer import (
+    REASON_MARKOVIAN,
+    analyze_model,
+)
+from inferno_tpu.core import System
+
+from fixtures import make_server, make_system_spec
+
+
+def test_analyze_model_returns_sorted_candidates():
+    system = System(make_system_spec(servers=[make_server(arrival_rate=1200.0)]))
+    name = next(iter(system.servers))
+    resp = analyze_model(system, name)
+    assert resp.reason == REASON_MARKOVIAN
+    assert resp.allocations, "loaded server must have candidates"
+    values = [a.value for a in resp.allocations]
+    assert values == sorted(values)
+    assert resp.required_prefill_qps > 0
+    assert resp.required_decode_qps == resp.required_prefill_qps
+
+
+def test_analyze_model_unknown_server():
+    system = System(make_system_spec())
+    with pytest.raises(KeyError):
+        analyze_model(system, "nope:nowhere")
+
+
+def test_concurrent_sizing_is_deterministic():
+    """64 threads size the same configuration; every result must be
+    identical to the single-threaded one (no shared mutable state)."""
+    qa = build_analyzer(
+        max_batch=32,
+        max_queue=320,
+        decode=DecodeParms(18.0, 0.3),
+        prefill=PrefillParms(5.0, 0.02),
+        request=RequestSize(128, 128),
+    )
+    targets = TargetPerf(target_ttft=500.0, target_itl=24.0)
+    expected = qa.size(targets)
+
+    results = [None] * 64
+    errors = []
+    barrier = threading.Barrier(16)
+
+    def worker(i):
+        try:
+            if i < 16:
+                barrier.wait()  # maximize overlap for the first wave
+            results[i] = qa.size(targets)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(64)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for r in results:
+        assert r == expected
+
+
+def test_concurrent_system_cycles_are_independent():
+    """Whole sizing cycles on distinct System objects in parallel: results
+    must match serial runs (the reference's TheSystem singleton made this
+    impossible)."""
+    def run_cycle():
+        system = System(make_system_spec(servers=[make_server(arrival_rate=2400.0)]))
+        system.calculate_all()
+        name = next(iter(system.servers))
+        best = min(system.servers[name].all_allocations.values(), key=lambda a: a.value)
+        return (best.accelerator, best.num_replicas, round(best.cost, 6))
+
+    expected = run_cycle()
+    results = [None] * 16
+    def worker(i):
+        results[i] = run_cycle()
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r == expected for r in results)

@@ -28,8 +28,6 @@ def test_va_create_wakes_update_does_not():
     cluster.set_configmap("elsewhere", "inferno-autoscaler-config", {"k": "v"})
     assert len(woke) == 2  # right name, wrong namespace
 
-    from test_controller import make_cluster as _  # noqa: F401
-
     from inferno_tpu.controller.crd import VariantAutoscaling, VariantAutoscalingSpec
 
     va = VariantAutoscaling(name="x", namespace="ns",
@@ -155,6 +153,62 @@ def test_http_watch_stream_wakes_on_va_added():
     # reconnect would not replay existing objects as synthetic ADDEDs
     assert srv.list_requests and "watch" not in srv.list_requests[0]
     assert "resourceVersion=41" in srv.watch_requests[0]
+
+
+def test_http_watch_recovers_from_410_gone():
+    """A compacted resourceVersion rejected at watch establishment (HTTP
+    410 before any ERROR event) must trigger a relist, not a dead retry
+    loop: the first list hands out a soon-compacted rv=41; the watch at
+    rv=41 is rejected with 410; the relist returns rv=42 and the watch at
+    rv=42 streams an event."""
+    events = [{"type": "ADDED", "object": {"kind": "VariantAutoscaling",
+                                           "metadata": {"resourceVersion": "50"}}}]
+    state = {"lists": 0, "gones": 0}
+    srv_done = threading.Event()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if "watch=true" not in self.path:
+                state["lists"] += 1
+                rv = "41" if state["lists"] == 1 else "42"
+                body = json.dumps({"metadata": {"resourceVersion": rv},
+                                   "items": []}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if "resourceVersion=41" in self.path:
+                state["gones"] += 1
+                self.send_response(410)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.end_headers()
+            for evt in events:
+                self.wfile.write((json.dumps(evt) + "\n").encode())
+                self.wfile.flush()
+            srv_done.set()
+            time.sleep(0.5)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    woke = []
+    w = Watcher(_FakeRestKube(f"http://127.0.0.1:{httpd.server_port}"),
+                lambda: woke.append(1), config_namespace=CFG_NS)
+    t = threading.Thread(target=w._run_va_stream, daemon=True)
+    t.start()
+    assert srv_done.wait(10)
+    time.sleep(0.1)
+    w.stop()
+    httpd.shutdown()
+    assert state["gones"] == 1  # stale rv rejected exactly once
+    assert state["lists"] == 2  # initial list + post-410 relist
+    assert len(woke) == 1
 
 
 def test_http_watch_stream_wakes_on_watched_cm():
