@@ -19,11 +19,11 @@ test:
 # Math/library tiers only (fast; no HTTP servers).
 test-unit:
 	$(PYTHON) -m pytest tests/ -x -q \
-	  --ignore=tests/test_emulator.py --ignore=tests/test_e2e.py
+	  --ignore=tests/test_emulator.py --ignore=tests/test_e2e_http.py
 
-# e2e tier: emulator HTTP server + controller loop end to end.
+# e2e tier: emulator HTTP server + MiniProm + controller loop over sockets.
 test-e2e:
-	$(PYTHON) -m pytest tests/test_emulator.py tests/test_e2e.py -x -q
+	$(PYTHON) -m pytest tests/test_emulator.py tests/test_e2e_http.py -x -q
 
 # Benchmark: one JSON line (fleet sizing cycle vs reference algorithm).
 bench:

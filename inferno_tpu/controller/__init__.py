@@ -1,10 +1,16 @@
+"""Controller package.
+
+The reconciler (and its solver stack) loads lazily via PEP 562 so that
+lightweight submodules — watch transport, CRD types, constants — can be
+imported without paying the solver import cost.
+"""
+
 from inferno_tpu.controller.crd import (
     VariantAutoscaling,
     VariantAutoscalingSpec,
     VariantAutoscalingStatus,
 )
 from inferno_tpu.controller.kube import InMemoryCluster, KubeClient
-from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
 
 __all__ = [
     "VariantAutoscaling",
@@ -15,3 +21,11 @@ __all__ = [
     "Reconciler",
     "ReconcilerConfig",
 ]
+
+
+def __getattr__(name):
+    if name in ("Reconciler", "ReconcilerConfig"):
+        from inferno_tpu.controller import reconciler
+
+        return getattr(reconciler, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

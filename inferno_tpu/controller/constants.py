@@ -1,0 +1,11 @@
+"""Shared controller constants (kept dependency-free so the watch module
+and tooling can import them without pulling in the solver/jax stack).
+
+ConfigMap names mirror the reference's configuration surface
+(/root/reference/internal/controller/variantautoscaling_controller.go:
+490-514, 584-594) on this build's naming.
+"""
+
+CM_CONFIG = "inferno-autoscaler-config"
+CM_ACCELERATOR_COSTS = "accelerator-unit-costs"
+CM_SERVICE_CLASSES = "service-classes-config"

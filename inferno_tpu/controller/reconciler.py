@@ -59,10 +59,13 @@ from inferno_tpu.solver import Optimizer
 
 DEFAULT_INTERVAL_SECONDS = 60  # reference: variantautoscaling_controller.go:94-101
 
-# ConfigMap names (reference: variantautoscaling_controller.go:490-514, 584-594)
-CM_CONFIG = "inferno-autoscaler-config"
-CM_ACCELERATOR_COSTS = "accelerator-unit-costs"
-CM_SERVICE_CLASSES = "service-classes-config"
+# ConfigMap names live in the dependency-free constants module so the
+# watch transport can import them without the solver/jax stack
+from inferno_tpu.controller.constants import (  # noqa: E402,F401 (re-export)
+    CM_ACCELERATOR_COSTS,
+    CM_CONFIG,
+    CM_SERVICE_CLASSES,
+)
 
 
 @dataclasses.dataclass

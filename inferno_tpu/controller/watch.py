@@ -26,12 +26,12 @@ import urllib.error
 import urllib.request
 from typing import Callable
 
-from inferno_tpu.controller.crd import GROUP, PLURAL, VERSION
-from inferno_tpu.controller.reconciler import (
+from inferno_tpu.controller.constants import (
     CM_ACCELERATOR_COSTS,
     CM_CONFIG,
     CM_SERVICE_CLASSES,
 )
+from inferno_tpu.controller.crd import GROUP, PLURAL, VERSION
 
 WATCHED_CONFIGMAPS = (CM_CONFIG, CM_ACCELERATOR_COSTS, CM_SERVICE_CLASSES)
 
