@@ -74,6 +74,58 @@ class ConfigMapKeyRef:
         return cls(name=d.get("name", ""), key=d.get("key", ""))
 
 
+def _perf_parms_to_dict(decode: DecodeParms, prefill: PrefillParms) -> dict[str, Any]:
+    """Wire shape shared by profiles and context buckets: string-valued
+    maps, like the reference (variantautoscaling_types.go:41-50)."""
+    return {
+        "decodeParms": {"alpha": str(decode.alpha), "beta": str(decode.beta)},
+        "prefillParms": {"gamma": str(prefill.gamma), "delta": str(prefill.delta)},
+    }
+
+
+def _perf_parms_from_dict(d: Mapping[str, Any]) -> tuple[DecodeParms, PrefillParms]:
+    perf = d.get("perfParms", {}) or {}
+    dp = perf.get("decodeParms", {}) or {}
+    pp = perf.get("prefillParms", {}) or {}
+    return (
+        DecodeParms(alpha=float(dp.get("alpha", 0) or 0), beta=float(dp.get("beta", 0) or 0)),
+        PrefillParms(gamma=float(pp.get("gamma", 0) or 0), delta=float(pp.get("delta", 0) or 0)),
+    )
+
+
+@dataclasses.dataclass
+class ContextBucket:
+    """Latency profile measured at a context-length bucket.
+
+    Long-context serving shifts α/β/γ/δ (longer KV reads per decode step,
+    larger prefill): profiles are fitted per context bucket and the
+    controller selects the bucket matching the variant's observed average
+    input length (SURVEY §5.7 — long context as profile dimensions; the
+    optimizer machinery is unchanged)."""
+
+    max_in_tokens: int  # bucket upper bound, e.g. 4096 / 16384 / 65536
+    decode_parms: DecodeParms = dataclasses.field(default_factory=DecodeParms)
+    prefill_parms: PrefillParms = dataclasses.field(default_factory=PrefillParms)
+    max_batch_size: int = 0  # 0 = inherit the profile's base batch
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "maxInTokens": self.max_in_tokens,
+            "maxBatchSize": self.max_batch_size,
+            "perfParms": _perf_parms_to_dict(self.decode_parms, self.prefill_parms),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ContextBucket":
+        decode, prefill = _perf_parms_from_dict(d)
+        return cls(
+            max_in_tokens=int(d.get("maxInTokens", 0) or 0),
+            max_batch_size=int(d.get("maxBatchSize", 0) or 0),
+            decode_parms=decode,
+            prefill_parms=prefill,
+        )
+
+
 @dataclasses.dataclass
 class AcceleratorProfile:
     """Per-slice-shape performance profile carried on the CR
@@ -88,16 +140,34 @@ class AcceleratorProfile:
     # JetStream-style disaggregated serving: one replica is then an atomic
     # unit of prefill+decode engines (inferno_tpu.analyzer.disagg)
     disagg: DisaggSpec | None = None
+    # optional context-length-bucketed profiles, sorted ascending by
+    # maxInTokens; base parms serve loads beyond the largest bucket
+    context_buckets: list[ContextBucket] = dataclasses.field(default_factory=list)
 
-    def to_perf_spec(self, model_id: str) -> ModelPerfSpec:
+    def bucket_for(self, avg_in_tokens: float) -> ContextBucket | None:
+        """Smallest bucket covering the observed average input length."""
+        if avg_in_tokens <= 0:
+            return None
+        eligible = [b for b in self.context_buckets if b.max_in_tokens >= avg_in_tokens]
+        if not eligible:
+            return None
+        return min(eligible, key=lambda b: b.max_in_tokens)
+
+    def to_perf_spec(self, model_id: str, avg_in_tokens: float = 0.0) -> ModelPerfSpec:
+        decode, prefill, batch = self.decode_parms, self.prefill_parms, self.max_batch_size
+        bucket = self.bucket_for(avg_in_tokens)
+        if bucket is not None:
+            decode, prefill = bucket.decode_parms, bucket.prefill_parms
+            if bucket.max_batch_size > 0:
+                batch = bucket.max_batch_size
         return ModelPerfSpec(
             name=model_id,
             acc=self.acc,
             slices_per_replica=self.acc_count,
-            max_batch_size=self.max_batch_size,
-            at_tokens=self.at_tokens or self.max_batch_size,
-            decode_parms=self.decode_parms,
-            prefill_parms=self.prefill_parms,
+            max_batch_size=batch,
+            at_tokens=self.at_tokens or batch,
+            decode_parms=decode,
+            prefill_parms=prefill,
             disagg=self.disagg,
         )
 
@@ -107,42 +177,30 @@ class AcceleratorProfile:
             "accCount": self.acc_count,
             "maxBatchSize": self.max_batch_size,
             "atTokens": self.at_tokens,
-            "perfParms": {
-                # string-valued maps on the wire, like the reference
-                # (variantautoscaling_types.go:41-50)
-                "decodeParms": {
-                    "alpha": str(self.decode_parms.alpha),
-                    "beta": str(self.decode_parms.beta),
-                },
-                "prefillParms": {
-                    "gamma": str(self.prefill_parms.gamma),
-                    "delta": str(self.prefill_parms.delta),
-                },
-            },
+            "perfParms": _perf_parms_to_dict(self.decode_parms, self.prefill_parms),
         }
         if self.disagg is not None:
             out["disagg"] = self.disagg.to_dict()
+        if self.context_buckets:
+            out["contextBuckets"] = [b.to_dict() for b in self.context_buckets]
         return out
 
     @classmethod
     def from_dict(cls, d: Mapping[str, Any]) -> "AcceleratorProfile":
-        perf = d.get("perfParms", {}) or {}
-        dp = perf.get("decodeParms", {}) or {}
-        pp = perf.get("prefillParms", {}) or {}
+        decode, prefill = _perf_parms_from_dict(d)
         dg = d.get("disagg")
         return cls(
             acc=d.get("acc", ""),
             acc_count=int(d.get("accCount", 1) or 1),
             max_batch_size=int(d.get("maxBatchSize", 1) or 1),
             at_tokens=int(d.get("atTokens", 0) or 0),
-            decode_parms=DecodeParms(
-                alpha=float(dp.get("alpha", 0) or 0), beta=float(dp.get("beta", 0) or 0)
-            ),
-            prefill_parms=PrefillParms(
-                gamma=float(pp.get("gamma", 0) or 0),
-                delta=float(pp.get("delta", 0) or 0),
-            ),
+            decode_parms=decode,
+            prefill_parms=prefill,
             disagg=DisaggSpec.from_dict(dg) if dg is not None else None,
+            context_buckets=sorted(
+                (ContextBucket.from_dict(b) for b in d.get("contextBuckets", []) or []),
+                key=lambda b: b.max_in_tokens,
+            ),
         )
 
 

@@ -285,17 +285,25 @@ class Reconciler:
         if slo is None:
             report.errors.append(f"{va.full_name}: no SLO entry for model {va.spec.model_id}")
             return False
-        class_name, _ = slo
+        class_name, target = slo
+
+        # Perf data registers under a per-variant model key: the registry is
+        # keyed (model, acc) with last-wins semantics, so two variants
+        # sharing a modelID would otherwise overwrite each other's profiles
+        # (which differ per variant: CR-carried parms, context buckets
+        # selected by each variant's own observed load). The SLO target is
+        # duplicated onto the key; `classes` is rebuilt every cycle.
+        model_key = f"{va.spec.model_id}@{va.full_name}"
+        for sc in classes:
+            if sc.name == class_name and sc.target_for(model_key) is None:
+                sc.model_targets.append(dataclasses.replace(target, model=model_key))
 
         # per-accelerator perf profiles from the CR
-        # (reference AddModelAcceleratorProfileToSystemData: utils.go:185-234)
-        added_profile = False
-        for prof in va.spec.accelerators:
-            if prof.acc not in accelerators:
-                continue
-            spec.models.append(prof.to_perf_spec(va.spec.model_id))
-            added_profile = True
-        if not added_profile:
+        # (reference AddModelAcceleratorProfileToSystemData: utils.go:185-234);
+        # materialized after load collection so context-bucketed profiles can
+        # select the bucket matching the observed average input length
+        matching_profiles = [p for p in va.spec.accelerators if p.acc in accelerators]
+        if not matching_profiles:
             report.errors.append(f"{va.full_name}: no profile matches a known slice shape")
             return False
 
@@ -337,13 +345,20 @@ class Reconciler:
             return False
         va.status.current_alloc = current
 
+        for prof in matching_profiles:
+            spec.models.append(
+                prof.to_perf_spec(
+                    model_key, avg_in_tokens=current.load.avg_input_tokens
+                )
+            )
+
         # server entry (reference AddServerInfoToSystemData: utils.go:237-311)
         min_replicas = 0 if self.config.scale_to_zero else 1
         spec.servers.append(
             ServerSpec(
                 name=va.full_name,
                 class_name=class_name,
-                model=va.spec.model_id,
+                model=model_key,
                 keep_accelerator=True,  # pinned across cycles (utils.go:290)
                 min_num_replicas=min_replicas,
                 current_alloc=AllocationData(

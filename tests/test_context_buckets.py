@@ -1,0 +1,123 @@
+"""Context-length-bucketed profiles (SURVEY §5.7: long context as profile
+dimensions; bucket selected by observed average input length)."""
+
+from inferno_tpu.config.types import DecodeParms, PrefillParms
+from inferno_tpu.controller.crd import AcceleratorProfile, ContextBucket
+
+from test_controller import CFG_NS, NS, make_cluster, make_prom
+from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+
+
+def profile_with_buckets():
+    return AcceleratorProfile(
+        acc="v5e-4", acc_count=1, max_batch_size=64, at_tokens=128,
+        decode_parms=DecodeParms(30.0, 0.6),       # base: beyond-largest-bucket
+        prefill_parms=PrefillParms(8.0, 0.05),
+        context_buckets=[
+            ContextBucket(max_in_tokens=4096,
+                          decode_parms=DecodeParms(18.0, 0.3),
+                          prefill_parms=PrefillParms(5.0, 0.02)),
+            ContextBucket(max_in_tokens=16384,
+                          decode_parms=DecodeParms(22.0, 0.45),
+                          prefill_parms=PrefillParms(6.0, 0.03),
+                          max_batch_size=32),
+        ],
+    )
+
+
+def test_bucket_selection():
+    prof = profile_with_buckets()
+    assert prof.bucket_for(0) is None
+    assert prof.bucket_for(512).max_in_tokens == 4096
+    assert prof.bucket_for(4096).max_in_tokens == 4096
+    assert prof.bucket_for(9000).max_in_tokens == 16384
+    assert prof.bucket_for(30000) is None  # beyond largest: base parms
+
+
+def test_to_perf_spec_applies_bucket():
+    prof = profile_with_buckets()
+    short = prof.to_perf_spec("m", avg_in_tokens=1000)
+    assert short.decode_parms.alpha == 18.0 and short.max_batch_size == 64
+    mid = prof.to_perf_spec("m", avg_in_tokens=9000)
+    assert mid.decode_parms.alpha == 22.0
+    assert mid.max_batch_size == 32  # bucket override
+    long = prof.to_perf_spec("m", avg_in_tokens=64000)
+    assert long.decode_parms.alpha == 30.0  # base fallback
+
+
+def test_round_trip_wire_format():
+    prof = profile_with_buckets()
+    again = AcceleratorProfile.from_dict(prof.to_dict())
+    assert again.context_buckets == prof.context_buckets
+
+
+def test_reconcile_selects_bucket_from_observed_load():
+    """Observed long-context load (in_tok=9000) must size with the 16k
+    bucket's slower profile, yielding more replicas than short-context
+    load at the same rate."""
+    def desired_with(in_tok):
+        cluster = make_cluster(replicas=1)
+        va = cluster.get_variant_autoscaling(NS, "llama-premium")
+        va.spec.accelerators = [profile_with_buckets()]
+        cluster.add_variant_autoscaling(va)
+        rec = Reconciler(kube=cluster, prom=make_prom(arrival_rps=20.0, in_tok=in_tok),
+                         config=ReconcilerConfig(config_namespace=CFG_NS,
+                                                 compute_backend="scalar"))
+        rec.run_cycle()
+        out = cluster.get_variant_autoscaling(NS, "llama-premium")
+        return out.status.desired_optimized_alloc.num_replicas
+
+    assert desired_with(9000) > desired_with(1000)
+
+
+def test_two_variants_sharing_model_id_keep_their_own_profiles():
+    """Two VAs share a modelID but carry different profiles; each must be
+    sized from its OWN profile. (The perf registry is keyed per variant:
+    with a shared key, the last-prepared VA's parms would clobber the
+    other's and both would size identically.)"""
+    import time as _time
+
+    from inferno_tpu.controller.crd import (
+        ACCELERATOR_LABEL,
+        ConfigMapKeyRef,
+        VariantAutoscaling as VA,
+        VariantAutoscalingSpec,
+    )
+    from inferno_tpu.controller.promclient import FakeProm, Sample
+    from test_controller import MODEL
+
+    fast_profile = AcceleratorProfile(
+        acc="v5e-4", acc_count=1, max_batch_size=64, at_tokens=128,
+        decode_parms=DecodeParms(18.0, 0.3), prefill_parms=PrefillParms(5.0, 0.0005),
+    )
+
+    cluster = make_cluster(replicas=1)
+    cluster.delete_variant_autoscaling(NS, "llama-premium")
+    for name, prof in (("va-bucketed", profile_with_buckets()),
+                       ("va-fast", fast_profile)):
+        va = VA(name=name, namespace=NS, labels={ACCELERATOR_LABEL: "v5e-4"},
+                spec=VariantAutoscalingSpec(
+                    model_id=MODEL,
+                    slo_class_ref=ConfigMapKeyRef("service-classes-config", "Premium"),
+                    accelerators=[prof]))
+        cluster.add_variant_autoscaling(va)
+        cluster.add_deployment(NS, name, replicas=1)
+
+    # both variants observe the same series (they share model_name):
+    # 20 req/s at 9000 avg input tokens
+    prom = FakeProm()
+    prom.add_handler(lambda q: True, lambda q: [Sample(labels={}, value=(
+        20.0 if "success" in q else (9000.0 if ("prompt" in q or "input" in q) else 64.0)
+    ), timestamp=_time.time())])
+    rec = Reconciler(kube=cluster, prom=prom,
+                     config=ReconcilerConfig(config_namespace=CFG_NS,
+                                             compute_backend="scalar"))
+    report = rec.run_cycle()
+    assert report.variants_applied == 2, report
+    bucketed = cluster.get_variant_autoscaling(
+        NS, "va-bucketed").status.desired_optimized_alloc
+    fast = cluster.get_variant_autoscaling(
+        NS, "va-fast").status.desired_optimized_alloc
+    # the bucketed profile's 16k-context parms are slower than the fast
+    # profile's: the variants MUST diverge despite the shared modelID
+    assert bucketed.num_replicas > fast.num_replicas >= 1, (bucketed, fast)
