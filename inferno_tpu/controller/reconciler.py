@@ -289,10 +289,13 @@ class Reconciler:
 
         # Perf data registers under a per-variant model key: the registry is
         # keyed (model, acc) with last-wins semantics, so two variants
-        # sharing a modelID would otherwise overwrite each other's profiles
-        # (which differ per variant: CR-carried parms, context buckets
-        # selected by each variant's own observed load). The SLO target is
-        # duplicated onto the key; `classes` is rebuilt every cycle.
+        # sharing a modelID would otherwise overwrite each other's
+        # CR-carried profiles. (Bucket selection by observed load is
+        # per-variant only across namespaces: metrics are queried by
+        # (model, namespace), the same granularity as the reference, so
+        # same-namespace variants of one model see a blended series.) The
+        # SLO target is duplicated onto the key; `classes` is rebuilt every
+        # cycle.
         model_key = f"{va.spec.model_id}@{va.full_name}"
         for sc in classes:
             if sc.name == class_name and sc.target_for(model_key) is None:
