@@ -29,3 +29,39 @@ def test_dryrun_multichip_8():
 @pytest.mark.parametrize("n", [2, 4])
 def test_dryrun_multichip_small(n):
     graft.dryrun_multichip(n)
+
+
+def test_dryrun_does_not_trust_wrong_backend():
+    """Round-1 driver failure mode: jax already initialized on the wrong
+    backend (there: the real TPU platform; here simulated by a CPU backend
+    with only ONE device) when dryrun_multichip(8) is called. The dryrun
+    must not attempt in-process repair — it must re-execute in a
+    subprocess whose environment pins 8 virtual CPU devices."""
+    import os
+    import subprocess
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    script = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "assert len(jax.devices()) == 1, jax.devices()\n"  # wrong backend live
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+        "assert len(jax.devices()) == 1\n"  # parent backend untouched
+        "print('DRYRUN_OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "DRYRUN_OK" in proc.stdout
