@@ -173,6 +173,14 @@ class TLSConfig:
 
         cert = os.environ.get("METRICS_TLS_CERT_PATH", "")
         key = os.environ.get("METRICS_TLS_KEY_PATH", "")
+        if bool(cert) != bool(key):
+            # Half-configured TLS must fail loudly, not silently serve
+            # /metrics over plaintext.
+            raise ValueError(
+                "METRICS_TLS_CERT_PATH and METRICS_TLS_KEY_PATH must be set "
+                f"together (cert={'set' if cert else 'unset'}, "
+                f"key={'set' if key else 'unset'})"
+            )
         return cls(cert, key) if cert and key else None
 
 

@@ -19,7 +19,7 @@ import dataclasses
 import json
 import threading
 import time
-from typing import Any
+from typing import Any, Callable
 
 import yaml
 
@@ -124,6 +124,12 @@ class Reconciler:
         self.log = get_logger("inferno.reconciler")
         # set by a Watcher (or anyone) to trigger the next cycle early
         self._wake = threading.Event()
+        # Leadership gate, re-checked at every write: a leader deposed
+        # mid-cycle (renew failure / lease takeover) must not keep writing
+        # VA status or actuating scale concurrently with the new leader.
+        # controller-runtime avoids this window by killing the process on
+        # lost leadership; we stop at the next write instead.
+        self.gate: Callable[[], bool] = lambda: True
 
     def poke(self) -> None:
         """Request an immediate reconcile (watch-event trigger)."""
@@ -264,6 +270,8 @@ class Reconciler:
             if existing.get("kind") == "Deployment" and existing.get("name") == ref["name"]:
                 return
         va.owner_references.append(ref)
+        if not self.gate():
+            return  # deposed mid-cycle: leave the patch to the new leader
         try:
             self.kube.patch_variant_autoscaling_meta(va)
         except KubeError:
@@ -333,10 +341,11 @@ class Reconciler:
                 REASON_METRICS_UNAVAILABLE,
                 "metrics unavailable; skipping optimization for this variant",
             )
-            try:
-                self.kube.update_variant_autoscaling_status(va)
-            except KubeError:
-                pass
+            if self.gate():  # a deposed leader must not write status
+                try:
+                    self.kube.update_variant_autoscaling_status(va)
+                except KubeError:
+                    pass
             return False
 
         acc_name = va.labels.get("inference.optimization/acceleratorName", "")
@@ -433,6 +442,9 @@ class Reconciler:
             report.optimization_ok = False
             report.errors.append(f"optimize: {e}")
             for va in prepared:
+                if not self.gate():
+                    report.errors.append("leadership lost; stopping status writes")
+                    break
                 va.status.set_condition(
                     TYPE_OPTIMIZATION_READY, "False", REASON_OPTIMIZATION_FAILED, str(e)
                 )
@@ -454,6 +466,11 @@ class Reconciler:
         """(reference applyOptimizedAllocations: controller.go:338-407)"""
         now = _utcnow()
         for va in prepared:
+            if not self.gate():
+                report.errors.append(
+                    "leadership lost mid-cycle; aborting actuation and status writes"
+                )
+                return
             try:
                 fresh = self.kube.get_variant_autoscaling(va.namespace, va.name)
             except KubeError as e:
@@ -501,6 +518,7 @@ class Reconciler:
 
         from inferno_tpu.controller.logger import kv
 
+        self.gate = gate
         while not stop_check():
             if not gate():
                 time.sleep(1)

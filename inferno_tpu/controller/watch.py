@@ -139,8 +139,22 @@ class Watcher:
                 # connection-level and mid-stream failures (IncompleteRead
                 # is an HTTPException, not an OSError) both just reconnect
                 pass
+            except Exception:
+                # Anything else (a kube client without .ctx, an unexpected
+                # watch_request error, …) must not kill the stream thread
+                # silently — that would permanently degrade the controller
+                # to interval-only reconciles with no trace. Log, resync,
+                # and reconnect with backoff like any other failure.
+                self._log().exception("watch stream error on %s", base_path)
+                rv = None
             self._stop.wait(backoff)
             backoff = min(backoff * 2, 30.0)
+
+    @staticmethod
+    def _log():
+        from inferno_tpu.controller.logger import get_logger
+
+        return get_logger("inferno.watch")
 
     def _run_va_stream(self) -> None:
         def handle(evt: dict) -> None:

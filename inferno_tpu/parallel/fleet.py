@@ -161,6 +161,18 @@ def _bucket_k(cap: int) -> int:
     return k
 
 
+def _pad_lanes(n: int, chunk: int) -> int:
+    """Pad a bucket's lane count to the next power of two (>= 8), then to a
+    multiple of the mesh chunk. The fused multi-bucket program's jit cache
+    is keyed by every bucket's lane count, so without coarse padding any
+    single variant added to or removed from the fleet would recompile the
+    whole pipeline; with it, counts are stable within a 2x band."""
+    padded = 8
+    while padded < n:
+        padded *= 2
+    return padded + ((-padded) % chunk)
+
+
 def _jitted_multi(ks: tuple[int, ...], n_iters: int, use_pallas: bool):
     """One jitted program solving every occupancy bucket and concatenating
     the packed results — a single device round trip per cycle. Dispatch
@@ -228,7 +240,7 @@ def solve_fleet(
     for k_bucket, idx_list in sorted(buckets.items()):
         idx = np.asarray(idx_list)
         sub = FleetParams(*(a[idx] for a in params_np))
-        pad = (-len(idx)) % chunk
+        pad = _pad_lanes(len(idx), chunk) - len(idx)
         if pad:
             sub = FleetParams(
                 *(np.concatenate([a, np.repeat(a[:1], pad, axis=0)]) for a in sub)

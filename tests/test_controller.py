@@ -294,3 +294,40 @@ def test_health_server_probes():
     finally:
         hs.stop()
         ms.stop()
+
+
+def test_leadership_lost_mid_cycle_stops_writes():
+    """A leader deposed while a cycle is in flight must not keep writing
+    VA status / actuating scale concurrently with the new leader: the
+    gate is re-checked at every write, not just between cycles."""
+    cluster = make_cluster(replicas=1)
+    rec = reconciler(cluster, make_prom(arrival_rps=50.0))
+
+    before = cluster.get_variant_autoscaling(NS, "llama-premium")
+    assert before.status.desired_optimized_alloc.last_run_time == ""
+
+    rec.gate = lambda: False  # deposed before the apply phase
+    report = rec.run_cycle()
+
+    assert any("leadership lost" in e for e in report.errors)
+    assert report.variants_applied == 0
+    after = cluster.get_variant_autoscaling(NS, "llama-premium")
+    assert after.status.desired_optimized_alloc.last_run_time == ""
+    # prepare-phase writes are gated too: no owner-ref patch landed
+    assert not any(r["kind"] == "Deployment" for r in after.owner_references)
+
+
+def test_metrics_tls_half_config_fails_loudly(monkeypatch):
+    """Only one of cert/key set => hard error, never silent plaintext."""
+    from inferno_tpu.controller.metrics import TLSConfig
+
+    monkeypatch.setenv("METRICS_TLS_CERT_PATH", "/tmp/tls.crt")
+    monkeypatch.delenv("METRICS_TLS_KEY_PATH", raising=False)
+    with pytest.raises(ValueError, match="must be set together"):
+        TLSConfig.from_env()
+    monkeypatch.delenv("METRICS_TLS_CERT_PATH", raising=False)
+    monkeypatch.setenv("METRICS_TLS_KEY_PATH", "/tmp/tls.key")
+    with pytest.raises(ValueError, match="must be set together"):
+        TLSConfig.from_env()
+    monkeypatch.delenv("METRICS_TLS_KEY_PATH", raising=False)
+    assert TLSConfig.from_env() is None

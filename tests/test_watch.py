@@ -229,3 +229,25 @@ def test_http_watch_stream_wakes_on_watched_cm():
     w.stop()
     srv.stop()
     assert len(woke) == 1
+
+
+def test_watch_stream_survives_unexpected_exception():
+    """An exception outside the anticipated set (here: a kube client whose
+    watch_request itself raises) must not kill the stream thread silently
+    — it logs, backs off, and reconnects (ADVICE round 1)."""
+    calls = []
+
+    class BrokenKube:
+        def watch_request(self, path):
+            calls.append(path)
+            raise AttributeError("no ctx on this client")
+
+    w = Watcher(BrokenKube(), lambda: None, config_namespace=CFG_NS)
+    t = threading.Thread(target=w._run_va_stream, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while len(calls) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert t.is_alive()
+    assert len(calls) >= 2  # retried after the unexpected exception
+    w.stop()
