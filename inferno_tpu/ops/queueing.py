@@ -95,22 +95,23 @@ def _service_rate(p: FleetParams, n: jax.Array) -> jax.Array:
     return n / (prefill + decode)
 
 
-def _make_grid(p: FleetParams, k_max: int) -> _Grid:
+def _make_stage_grid(
+    base: jax.Array, slope: jax.Array, nmax_i: jax.Array, cap_i: jax.Array, k_max: int
+) -> _Grid:
+    """Birth-death grid for a batch server with per-request service time
+    t(n) = base + slope * min(n, nmax); occupancy capped at `cap`.
+
+    A cap beyond the padded grid is truncated to the grid edge: the
+    blocking state must exist on the grid or blocking mass is lost
+    (production bucketing guarantees k_max >= cap; this keeps direct
+    callers well-defined and the XLA/pallas backends in agreement).
+    """
     k = jnp.arange(1, k_max + 1, dtype=jnp.float32)[None, :]  # [1, K]
-    nmax = p.max_batch.astype(jnp.float32)
-    # a cap beyond the padded grid is truncated to the grid edge: the
-    # blocking state must exist on the grid or blocking mass is lost
-    # (production bucketing guarantees k_max >= cap; this keeps direct
-    # callers well-defined and the XLA/pallas backends in agreement)
-    cap = jnp.minimum(p.occupancy_cap, k_max)
+    nmax = nmax_i.astype(jnp.float32)
+    cap = jnp.minimum(cap_i, k_max)
     n_eff = jnp.minimum(k, nmax[:, None])
-    prefill = jnp.where(
-        p.in_tokens[:, None] > 0,
-        p.gamma[:, None] + p.delta[:, None] * p.in_tokens[:, None] * n_eff,
-        0.0,
-    )
-    decode = _num_decodes(p)[:, None] * (p.alpha[:, None] + p.beta[:, None] * n_eff)
-    log_mu = jnp.log(n_eff) - jnp.log(prefill + decode)
+    t = base[:, None] + slope[:, None] * n_eff
+    log_mu = jnp.log(n_eff) - jnp.log(t)
     valid = k <= cap.astype(jnp.float32)[:, None]
     log_mu = jnp.where(valid, log_mu, jnp.inf)  # +inf => p[k] = 0 beyond cap
     kk = jnp.arange(0, k_max + 1, dtype=jnp.float32)[None, :]
@@ -121,6 +122,20 @@ def _make_grid(p: FleetParams, k_max: int) -> _Grid:
         cap_idx=cap[:, None],
         nmax=nmax,
     )
+
+
+def _agg_base_slope(p: FleetParams) -> tuple[jax.Array, jax.Array]:
+    """Aggregated-lane service time t(n) = base + slope*n: prefill and
+    decode folded into one stage (mu(n) of analyzer.queue.service_rates)."""
+    nd = _num_decodes(p)
+    base = jnp.where(p.in_tokens > 0, p.gamma, 0.0) + nd * p.alpha
+    slope = jnp.where(p.in_tokens > 0, p.delta * p.in_tokens, 0.0) + nd * p.beta
+    return base, slope
+
+
+def _make_grid(p: FleetParams, k_max: int) -> _Grid:
+    base, slope = _agg_base_slope(p)
+    return _make_stage_grid(base, slope, p.max_batch, p.occupancy_cap, k_max)
 
 
 def _solve_stats(lam: jax.Array, grid: _Grid):
@@ -145,15 +160,27 @@ def _solve_stats(lam: jax.Array, grid: _Grid):
     return wait, serv, in_servers, throughput
 
 
+def _stage_concurrency(
+    serv: jax.Array, base: jax.Array, slope: jax.Array, nmax: jax.Array
+) -> jax.Array:
+    """Invert t(n) = base + slope*n to the concurrency n giving `serv`
+    (analyzer.queue.effective_concurrency / disagg._effective_concurrency)."""
+    numer = serv - base
+    safe = jnp.clip(numer / jnp.where(slope > 0, slope, 1.0), 0.0, nmax)
+    return jnp.where(slope > 0, safe, jnp.where(numer > 0, nmax, 0.0))
+
+
 def _concurrency(p: FleetParams, serv: jax.Array) -> jax.Array:
     """Effective concurrency from avg service time
-    (analyzer.queue.effective_concurrency)."""
+    (analyzer.queue.effective_concurrency). Note: plain gamma even for
+    in_tokens == 0 lanes, matching the scalar inversion."""
     tokens = p.out_tokens - 1.0
-    numer = serv - (p.gamma + p.alpha * tokens)
-    denom = p.delta * p.in_tokens + p.beta * tokens
-    nmax = p.max_batch.astype(jnp.float32)
-    safe = jnp.clip(numer / jnp.where(denom > 0, denom, 1.0), 0.0, nmax)
-    return jnp.where(denom > 0, safe, jnp.where(numer > 0, nmax, 0.0))
+    return _stage_concurrency(
+        serv,
+        p.gamma + p.alpha * tokens,
+        p.delta * p.in_tokens + p.beta * tokens,
+        p.max_batch.astype(jnp.float32),
+    )
 
 
 def _get_solver(use_pallas: bool):
@@ -174,23 +201,21 @@ def _ttft_itl_at(lam: jax.Array, p: FleetParams, grid: _Grid, solve=_solve_stats
 
 
 def _bisect_increasing(
-    p: FleetParams,
-    grid: _Grid,
     lam_min: jax.Array,
     lam_max: jax.Array,
     target: jax.Array,
     y_lo: jax.Array,
     y_hi: jax.Array,
-    which: int,  # 0: ttft, 1: itl
+    y_at,  # callable: lam -> metric value (vectorized over lanes)
     n_iters: int,
-    solve=_solve_stats,
 ):
     """Vectorized bisection for an increasing metric-of-rate.
 
     Returns (lam_star, feasible): lanes whose target is below the value at
     lam_min are infeasible; targets above the value at lam_max clamp to
     lam_max (the reference's -1/+1 indicator semantics,
-    pkg/analyzer/utils.go:44-50).
+    pkg/analyzer/utils.go:44-50). Shared by the aggregated and tandem
+    kernels so the indicator/clamp semantics cannot diverge.
     """
     feasible = target >= y_lo * (1.0 - 1e-6)
     clamp_hi = target >= y_hi
@@ -198,8 +223,7 @@ def _bisect_increasing(
     def body(_, state):
         lo, hi = state
         mid = 0.5 * (lo + hi)
-        y = _ttft_itl_at(mid, p, grid, solve)[which]
-        too_high = y > target
+        too_high = y_at(mid) > target
         return jnp.where(too_high, lo, mid), jnp.where(too_high, mid, hi)
 
     lo, hi = jax.lax.fori_loop(0, n_iters, body, (lam_min, lam_max))
@@ -249,12 +273,12 @@ def fleet_size(
     ttft_hi, itl_hi = _ttft_itl_at(lam_max, params, grid, solve)
 
     lam_ttft, ok_ttft = _bisect_increasing(
-        params, grid, lam_min, lam_max, params.target_ttft, ttft_lo, ttft_hi, 0,
-        n_iters, solve,
+        lam_min, lam_max, params.target_ttft, ttft_lo, ttft_hi,
+        lambda lam: _ttft_itl_at(lam, params, grid, solve)[0], n_iters,
     )
     lam_itl, ok_itl = _bisect_increasing(
-        params, grid, lam_min, lam_max, params.target_itl, itl_lo, itl_hi, 1,
-        n_iters, solve,
+        lam_min, lam_max, params.target_itl, itl_lo, itl_hi,
+        lambda lam: _ttft_itl_at(lam, params, grid, solve)[1], n_iters,
     )
     lam_ttft = jnp.where(params.target_ttft > 0, lam_ttft, lam_max)
     ok_ttft = jnp.where(params.target_ttft > 0, ok_ttft, True)
@@ -307,6 +331,167 @@ def make_fleet_size_fn(
 ):
     """Jitted fleet sizing specialized to a padded occupancy grid `k_max`."""
     return jax.jit(lambda params: fleet_size(params, k_max, n_iters, use_pallas))
+
+
+# -- disaggregated (prefill/decode tandem) lanes ------------------------------
+#
+# JetStream-style variants separate prefill and decode engines; one replica
+# is an atomic unit of (prefill_slices + decode_slices) engines. The scalar
+# semantics are inferno_tpu.analyzer.disagg (tandem of two birth-death
+# chains under the finite-buffer independence approximation); this is the
+# batched equivalent so disagg lanes ride the same jitted cycle as
+# aggregated ones instead of a sequential Python loop.
+
+
+class TandemParams(NamedTuple):
+    """Structure-of-arrays description of disaggregated lanes. Float arrays
+    f32[P], int arrays i32[P]; rates req/sec, times msec."""
+
+    alpha: jax.Array  # decode base, msec
+    beta: jax.Array  # decode slope, msec/req
+    gamma: jax.Array  # prefill base, msec
+    delta: jax.Array  # prefill slope, msec/(token*req)
+    in_tokens: jax.Array  # avg input tokens (> 0 for a prefill stage)
+    out_tokens: jax.Array  # avg output tokens (>= 1)
+    prefill_batch: jax.Array  # i32: per prefill engine
+    decode_batch: jax.Array  # i32: per decode engine
+    prefill_cap: jax.Array  # i32: prefill_batch + max queue
+    decode_cap: jax.Array  # i32: decode_batch + max queue
+    prefill_slices: jax.Array  # f32: prefill engines per replica unit
+    decode_slices: jax.Array  # f32: decode engines per replica unit
+    target_ttft: jax.Array  # msec; 0 disables
+    target_itl: jax.Array  # msec; 0 disables
+    target_tps: jax.Array  # tokens/sec; 0 disables
+    total_rate: jax.Array  # offered load, req/sec
+    min_replicas: jax.Array  # i32
+    cost_per_replica: jax.Array  # cents/hr for one whole unit
+
+
+def _tandem_num_decodes(p: TandemParams) -> jax.Array:
+    # analyzer.disagg._decode_rates: max(out_tokens - 1, 1)
+    return jnp.maximum(p.out_tokens - 1.0, 1.0)
+
+
+def _tandem_ttft_at(lam_unit: jax.Array, p: TandemParams, gp: _Grid, solve):
+    """TTFT depends only on the prefill stage (DisaggAnalyzer._ttft_at), so
+    the TTFT bisection skips the decode-stage solve entirely."""
+    p_slope = p.delta * p.in_tokens
+    pwait, pserv, _, _ = solve(lam_unit / p.prefill_slices, gp)
+    pconc = _stage_concurrency(pserv, p.gamma, p_slope, gp.nmax)
+    return pwait + p.gamma + p_slope * pconc
+
+
+def _tandem_eval(lam_unit: jax.Array, p: TandemParams, gp: _Grid, gd: _Grid, solve):
+    """Whole-unit metrics at unit arrival rates `lam_unit` (req/msec):
+    (ttft, itl, rho, unit throughput req/msec). Mirrors
+    DisaggAnalyzer._ttft_at/_itl_at/analyze."""
+    nd = _tandem_num_decodes(p)
+    p_slope = p.delta * p.in_tokens
+    pwait, pserv, p_inserv, ptput = solve(lam_unit / p.prefill_slices, gp)
+    pconc = _stage_concurrency(pserv, p.gamma, p_slope, gp.nmax)
+    ttft = pwait + p.gamma + p_slope * pconc
+
+    # decode stage sees the prefill stage's departures
+    through_unit = ptput * p.prefill_slices
+    dwait, dserv, d_inserv, dtput = solve(through_unit / p.decode_slices, gd)
+    dconc = _stage_concurrency(dserv / nd, p.alpha, p.beta, gd.nmax)
+    itl = p.alpha + p.beta * dconc
+
+    # utilization of the binding stage (DisaggAnalyzer.analyze)
+    rho = jnp.clip(
+        jnp.maximum(p_inserv / gp.nmax, d_inserv / gd.nmax), 0.0, 1.0
+    )
+    return ttft, itl, rho, dtput * p.decode_slices
+
+
+def tandem_fleet_size(
+    params: TandemParams,
+    k_max: int,
+    n_iters: int = DEFAULT_BISECT_ITERS,
+    use_pallas: bool = False,
+) -> FleetResult:
+    """Size every disaggregated lane: batched equivalent of
+    build_disagg_analyzer + DisaggAnalyzer.size + create_allocation's
+    arithmetic. `k_max` must cover both stages' occupancy caps (callers
+    bucket by max(prefill_cap, decode_cap))."""
+    solve = _get_solver(use_pallas)
+    nd = _tandem_num_decodes(params)
+    p_slope = params.delta * params.in_tokens
+    gp = _make_stage_grid(
+        params.gamma, p_slope, params.prefill_batch, params.prefill_cap, k_max
+    )
+    gd = _make_stage_grid(
+        nd * params.alpha, nd * params.beta, params.decode_batch, params.decode_cap,
+        k_max,
+    )
+
+    # stable range of the whole unit: the binding stage saturates first
+    # (analyzer.disagg.build_disagg_analyzer)
+    pb = params.prefill_batch.astype(jnp.float32)
+    db = params.decode_batch.astype(jnp.float32)
+    mu_p_full = pb / (params.gamma + p_slope * pb)
+    mu_d_full = db / (nd * (params.alpha + params.beta * db))
+    unit_max = jnp.minimum(
+        mu_p_full * params.prefill_slices, mu_d_full * params.decode_slices
+    )
+    lam_min = unit_max * _RATE_EPSILON
+    lam_max = unit_max * (1.0 - _RATE_EPSILON)
+
+    ttft_lo, itl_lo, _, _ = _tandem_eval(lam_min, params, gp, gd, solve)
+    ttft_hi, itl_hi, _, _ = _tandem_eval(lam_max, params, gp, gd, solve)
+
+    lam_ttft, ok_ttft = _bisect_increasing(
+        lam_min, lam_max, params.target_ttft, ttft_lo, ttft_hi,
+        lambda lam: _tandem_ttft_at(lam, params, gp, solve), n_iters,
+    )
+    lam_itl, ok_itl = _bisect_increasing(
+        lam_min, lam_max, params.target_itl, itl_lo, itl_hi,
+        lambda lam: _tandem_eval(lam, params, gp, gd, solve)[1], n_iters,
+    )
+    lam_ttft = jnp.where(params.target_ttft > 0, lam_ttft, lam_max)
+    ok_ttft = jnp.where(params.target_ttft > 0, ok_ttft, True)
+    lam_itl = jnp.where(params.target_itl > 0, lam_itl, lam_max)
+    ok_itl = jnp.where(params.target_itl > 0, ok_itl, True)
+    lam_tps = jnp.where(
+        params.target_tps > 0, lam_max * (1.0 - STABILITY_SAFETY_FRACTION), lam_max
+    )
+
+    lam_star = jnp.minimum(jnp.minimum(lam_ttft, lam_itl), lam_tps)
+    feasible = ok_ttft & ok_itl
+
+    # unit throughput at the binding rate -> per-unit capacity (req/sec)
+    tput_star = _tandem_eval(lam_star, params, gp, gd, solve)[3]
+    rate_star = tput_star * 1000.0
+
+    total = jnp.where(
+        params.target_tps > 0, params.target_tps / params.out_tokens, params.total_rate
+    )
+    replicas = jnp.ceil(total / rate_star).astype(jnp.int32)
+    replicas = jnp.maximum(replicas, params.min_replicas)
+    replicas = jnp.maximum(replicas, 1)
+    cost = replicas.astype(jnp.float32) * params.cost_per_replica
+
+    # expected per-unit operating point
+    per_unit = jnp.maximum(total / replicas.astype(jnp.float32) / 1000.0, lam_min)
+    ttft, itl, rho, _ = _tandem_eval(per_unit, params, gp, gd, solve)
+
+    return FleetResult(
+        feasible=feasible,
+        lambda_star=lam_star,
+        rate_star=rate_star,
+        num_replicas=replicas,
+        cost=cost,
+        itl=itl,
+        ttft=ttft,
+        rho=rho,
+    )
+
+
+def make_tandem_size_fn(
+    k_max: int, n_iters: int = DEFAULT_BISECT_ITERS, use_pallas: bool = False
+):
+    """Jitted tandem sizing specialized to a padded occupancy grid `k_max`."""
+    return jax.jit(lambda params: tandem_fleet_size(params, k_max, n_iters, use_pallas))
 
 
 def pack_result(res: FleetResult) -> jax.Array:

@@ -1,11 +1,22 @@
-from inferno_tpu.parallel.fleet import FleetPlan, build_fleet, calculate_fleet, solve_fleet
+from inferno_tpu.parallel.fleet import (
+    FleetPlan,
+    TandemPlan,
+    build_fleet,
+    build_tandem_fleet,
+    calculate_fleet,
+    solve_fleet,
+    solve_tandem_fleet,
+)
 from inferno_tpu.parallel.mesh import fleet_mesh, shard_fleet_params
 
 __all__ = [
     "FleetPlan",
+    "TandemPlan",
     "build_fleet",
+    "build_tandem_fleet",
     "calculate_fleet",
     "solve_fleet",
+    "solve_tandem_fleet",
     "fleet_mesh",
     "shard_fleet_params",
 ]

@@ -19,7 +19,6 @@ import numpy as np
 from inferno_tpu.core.allocation import (
     Allocation,
     _zero_load_allocation,
-    create_allocation,
     transition_penalty,
 )
 from inferno_tpu.core.system import System
@@ -28,6 +27,7 @@ from inferno_tpu.ops.queueing import (
     DEFAULT_BISECT_ITERS,
     FleetParams,
     FleetResult,
+    TandemParams,
     unpack_result,
 )
 from inferno_tpu.parallel.mesh import fleet_mesh, shard_fleet_params
@@ -40,6 +40,18 @@ class FleetPlan:
     """A flattened fleet batch plus the lane -> (server, acc) mapping."""
 
     params: FleetParams
+    lanes: list[tuple[str, str]]  # (server_name, acc_name) per lane
+
+    @property
+    def num_lanes(self) -> int:
+        return len(self.lanes)
+
+
+@dataclasses.dataclass
+class TandemPlan:
+    """Disaggregated (prefill/decode tandem) lanes of the fleet batch."""
+
+    params: TandemParams
     lanes: list[tuple[str, str]]  # (server_name, acc_name) per lane
 
     @property
@@ -84,7 +96,7 @@ def build_fleet(system: System) -> FleetPlan | None:
             if perf is None:
                 continue
             if perf.disagg is not None:
-                continue  # tandem model lanes go through the scalar fallback
+                continue  # tandem lanes are batched by build_tandem_fleet
             # non-positive service time => the scalar analyzer raises and
             # the pair is rejected; keep the batched path consistent
             nd = load.avg_out_tokens - 1
@@ -146,7 +158,116 @@ def build_fleet(system: System) -> FleetPlan | None:
     return FleetPlan(params=params, lanes=lanes)
 
 
-_fn_cache: dict[tuple[tuple[int, ...], int, bool], object] = {}
+def build_tandem_fleet(system: System) -> TandemPlan | None:
+    """Flatten all loaded disaggregated (server, slice-shape) pairs into a
+    TandemParams batch. Eligibility mirrors the scalar path
+    (create_allocation + build_disagg_analyzer): lanes the scalar analyzer
+    would reject (no prefill stage, invalid spec, non-positive stage
+    times) produce no candidate here either."""
+    cols: dict[str, list] = {
+        "alpha": [], "beta": [], "gamma": [], "delta": [],
+        "in_tokens": [], "out_tokens": [],
+        "prefill_batch": [], "decode_batch": [], "prefill_cap": [], "decode_cap": [],
+        "prefill_slices": [], "decode_slices": [],
+        "target_ttft": [], "target_itl": [], "target_tps": [],
+        "total_rate": [], "min_replicas": [], "cost_per_replica": [],
+    }
+    lanes: list[tuple[str, str]] = []
+
+    for server_name, server in system.servers.items():
+        load = server.load
+        if load is None or load.arrival_rate <= 0:
+            continue
+        if load.avg_in_tokens <= 0 or load.avg_out_tokens <= 0:
+            # the tandem model requires a prefill stage (disagg.py validates
+            # avg_in_tokens > 0); zero-load handled by the shortcut
+            continue
+        model = system.models.get(server.model_name)
+        svc = system.service_classes.get(server.service_class_name)
+        if model is None or svc is None:
+            continue
+        target = svc.target_for(server.model_name)
+        if target is None:
+            continue
+        for acc in server.candidate_accelerators(system).values():
+            perf = model.perf_data.get(acc.name)
+            if perf is None or perf.disagg is None:
+                continue
+            dg = perf.disagg
+            try:
+                dg.validate()
+            except ValueError:
+                continue
+            k_out = load.avg_out_tokens
+            if server.max_batch_size > 0:
+                batch = server.max_batch_size
+            else:
+                batch = max(perf.max_batch_size * perf.at_tokens // k_out, 1)
+            max_queue = batch * MAX_QUEUE_TO_BATCH_RATIO
+            p_batch = dg.prefill_max_batch or batch
+            # non-positive stage times => scalar analyzer raises; reject here
+            nd = max(k_out - 1, 1)
+            pf = perf.prefill_parms
+            dc = perf.decode_parms
+            p_times = (
+                pf.gamma + pf.delta * load.avg_in_tokens,
+                pf.gamma + pf.delta * load.avg_in_tokens * p_batch,
+            )
+            d_times = (dc.alpha + dc.beta, dc.alpha + dc.beta * batch)
+            if min(p_times) <= 0 or nd * min(d_times) <= 0:
+                continue
+            cols["alpha"].append(dc.alpha)
+            cols["beta"].append(dc.beta)
+            cols["gamma"].append(pf.gamma)
+            cols["delta"].append(pf.delta)
+            cols["in_tokens"].append(float(load.avg_in_tokens))
+            cols["out_tokens"].append(float(k_out))
+            cols["prefill_batch"].append(p_batch)
+            cols["decode_batch"].append(batch)
+            cols["prefill_cap"].append(p_batch + max_queue)
+            cols["decode_cap"].append(batch + max_queue)
+            cols["prefill_slices"].append(float(dg.prefill_slices))
+            cols["decode_slices"].append(float(dg.decode_slices))
+            cols["target_ttft"].append(target.slo_ttft)
+            cols["target_itl"].append(target.slo_itl)
+            cols["target_tps"].append(target.slo_tps)
+            cols["total_rate"].append(load.arrival_rate / 60.0)
+            cols["min_replicas"].append(max(server.min_num_replicas, 0))
+            cols["cost_per_replica"].append(
+                acc.cost * model.slices_per_replica(acc.name)
+            )
+            lanes.append((server_name, acc.name))
+
+    if not lanes:
+        return None
+
+    def col(name, dtype):
+        return np.asarray(cols[name], dtype=dtype)
+
+    params = TandemParams(
+        alpha=col("alpha", np.float32),
+        beta=col("beta", np.float32),
+        gamma=col("gamma", np.float32),
+        delta=col("delta", np.float32),
+        in_tokens=col("in_tokens", np.float32),
+        out_tokens=col("out_tokens", np.float32),
+        prefill_batch=col("prefill_batch", np.int32),
+        decode_batch=col("decode_batch", np.int32),
+        prefill_cap=col("prefill_cap", np.int32),
+        decode_cap=col("decode_cap", np.int32),
+        prefill_slices=col("prefill_slices", np.float32),
+        decode_slices=col("decode_slices", np.float32),
+        target_ttft=col("target_ttft", np.float32),
+        target_itl=col("target_itl", np.float32),
+        target_tps=col("target_tps", np.float32),
+        total_rate=col("total_rate", np.float32),
+        min_replicas=col("min_replicas", np.int32),
+        cost_per_replica=col("cost_per_replica", np.float32),
+    )
+    return TandemPlan(params=params, lanes=lanes)
+
+
+_fn_cache: dict[tuple[tuple[tuple[str, int], ...], int, bool], object] = {}
 
 
 def _bucket_k(cap: int) -> int:
@@ -173,26 +294,28 @@ def _pad_lanes(n: int, chunk: int) -> int:
     return padded + ((-padded) % chunk)
 
 
-def _jitted_multi(ks: tuple[int, ...], n_iters: int, use_pallas: bool):
-    """One jitted program solving every occupancy bucket and concatenating
-    the packed results — a single device round trip per cycle. Dispatch
+def _jitted_multi(specs: tuple[tuple[str, int], ...], n_iters: int, use_pallas: bool):
+    """One jitted program solving every occupancy bucket — aggregated
+    ("agg") and disaggregated tandem ("tan") alike — and concatenating the
+    packed results: a single device round trip per cycle. Dispatch
     latency, not compute, dominates this workload (~15ms per call on a
     tunneled TPU backend), so fusing B bucket dispatches into one is a
-    ~Bx cycle-time win. Cache key includes the bucket K-signature; lane
-    counts are burned into the jit cache by argument shape as usual."""
+    ~Bx cycle-time win. Cache key includes each bucket's (kind, K)
+    signature; lane counts are burned into the jit cache by argument
+    shape as usual (coarsely padded by _pad_lanes)."""
     import jax.numpy as jnp
 
-    from inferno_tpu.ops.queueing import fleet_size, pack_result
+    from inferno_tpu.ops.queueing import fleet_size, pack_result, tandem_fleet_size
 
-    key = (ks, n_iters, use_pallas)
+    key = (specs, n_iters, use_pallas)
     fn = _fn_cache.get(key)
     if fn is None:
 
         def multi(*subs):
-            outs = [
-                pack_result(fleet_size(sub, k, n_iters, use_pallas))
-                for k, sub in zip(ks, subs)
-            ]
+            outs = []
+            for (kind, k), sub in zip(specs, subs):
+                sizer = tandem_fleet_size if kind == "tan" else fleet_size
+                outs.append(pack_result(sizer(sub, k, n_iters, use_pallas)))
             return jnp.concatenate(outs, axis=1)
 
         fn = jax.jit(multi)
@@ -200,27 +323,8 @@ def _jitted_multi(ks: tuple[int, ...], n_iters: int, use_pallas: bool):
     return fn
 
 
-def solve_fleet(
-    plan: FleetPlan,
-    mesh: jax.sharding.Mesh | None = None,
-    n_iters: int = DEFAULT_BISECT_ITERS,
-    use_pallas: bool = False,
-) -> FleetResult:
-    """Run the jitted batched sizing; optionally shard lanes over a mesh.
-
-    Lanes are grouped into power-of-two occupancy buckets and solved per
-    bucket: per-lane K varies by orders of magnitude across slice shapes,
-    and a single global grid would make every small lane pay for the
-    largest one. Buckets keep shapes static (one compilation per bucket
-    size, cached across cycles).
-    """
-    params_np = jax.tree.map(np.asarray, plan.params)
-    n = params_np.alpha.shape[0]
-    buckets: dict[int, list[int]] = {}
-    for i, cap in enumerate(params_np.occupancy_cap):
-        buckets.setdefault(_bucket_k(int(cap)), []).append(i)
-
-    out = FleetResult(
+def _empty_result(n: int) -> FleetResult:
+    return FleetResult(
         feasible=np.zeros(n, bool),
         lambda_star=np.zeros(n, np.float32),
         rate_star=np.zeros(n, np.float32),
@@ -230,37 +334,92 @@ def solve_fleet(
         ttft=np.zeros(n, np.float32),
         rho=np.zeros(n, np.float32),
     )
+
+
+def _solve_all(
+    plan: FleetPlan | None,
+    tandem: TandemPlan | None,
+    mesh: jax.sharding.Mesh | None,
+    n_iters: int,
+    use_pallas: bool,
+) -> tuple[FleetResult | None, FleetResult | None]:
+    """Solve aggregated and tandem lanes in ONE fused jitted program.
+
+    Lanes are grouped into power-of-two occupancy buckets per kind and
+    solved per bucket: per-lane K varies by orders of magnitude across
+    slice shapes, and a single global grid would make every small lane pay
+    for the largest one. Buckets keep shapes static (one compilation per
+    (kind, K, padded-lane-count) signature, cached across cycles).
+    """
     chunk = mesh.size if mesh is not None else 1
-    # all buckets solve inside ONE jitted program (single dispatch + single
-    # fetch): per-call round-trip latency dominates this workload on
-    # tunneled TPU backends, so B separate bucket calls would cost ~Bx
-    subs: list[FleetParams] = []
-    idxs: list[np.ndarray] = []
-    ks: list[int] = []
-    for k_bucket, idx_list in sorted(buckets.items()):
-        idx = np.asarray(idx_list)
-        sub = FleetParams(*(a[idx] for a in params_np))
-        pad = _pad_lanes(len(idx), chunk) - len(idx)
-        if pad:
-            sub = FleetParams(
-                *(np.concatenate([a, np.repeat(a[:1], pad, axis=0)]) for a in sub)
-            )
-        if mesh is not None:
-            sub = shard_fleet_params(sub, mesh)
-        subs.append(sub)
-        idxs.append(idx)
-        ks.append(k_bucket)
+    subs: list = []
+    specs: list[tuple[str, int]] = []
+    slots: list[tuple[str, np.ndarray, int]] = []  # (kind, orig indices, width)
+
+    def add(kind: str, params_np, bucket_caps: np.ndarray):
+        cls = type(params_np)
+        buckets: dict[int, list[int]] = {}
+        for i, cap in enumerate(bucket_caps):
+            buckets.setdefault(_bucket_k(int(cap)), []).append(i)
+        for k_bucket, idx_list in sorted(buckets.items()):
+            idx = np.asarray(idx_list)
+            sub = cls(*(a[idx] for a in params_np))
+            pad = _pad_lanes(len(idx), chunk) - len(idx)
+            if pad:
+                sub = cls(
+                    *(np.concatenate([a, np.repeat(a[:1], pad, axis=0)]) for a in sub)
+                )
+            if mesh is not None:
+                sub = shard_fleet_params(sub, mesh)
+            subs.append(sub)
+            specs.append((kind, k_bucket))
+            slots.append((kind, idx, len(idx) + pad))
+
+    agg_out = tan_out = None
+    if plan is not None and plan.num_lanes:
+        agg_out = _empty_result(plan.num_lanes)
+        params_np = jax.tree.map(np.asarray, plan.params)
+        add("agg", params_np, params_np.occupancy_cap)
+    if tandem is not None and tandem.num_lanes:
+        tan_out = _empty_result(tandem.num_lanes)
+        tp_np = jax.tree.map(np.asarray, tandem.params)
+        add("tan", tp_np, np.maximum(tp_np.prefill_cap, tp_np.decode_cap))
+    if not subs:
+        return agg_out, tan_out
 
     packed_all = np.asarray(
-        jax.device_get(_jitted_multi(tuple(ks), n_iters, use_pallas)(*subs))
+        jax.device_get(_jitted_multi(tuple(specs), n_iters, use_pallas)(*subs))
     )
     offset = 0
-    for idx, sub in zip(idxs, subs):
-        width = sub.alpha.shape[0]  # incl. mesh padding; no device fetch
+    for kind, idx, width in slots:
         res = unpack_result(packed_all[:, offset : offset + width])
         offset += width
+        out = agg_out if kind == "agg" else tan_out
         for field, dst in zip(res, out):
             dst[idx] = np.asarray(field)[: len(idx)]
+    return agg_out, tan_out
+
+
+def solve_fleet(
+    plan: FleetPlan,
+    mesh: jax.sharding.Mesh | None = None,
+    n_iters: int = DEFAULT_BISECT_ITERS,
+    use_pallas: bool = False,
+) -> FleetResult:
+    """Run the jitted batched sizing for aggregated lanes; optionally shard
+    lanes over a mesh. (Tandem lanes: see solve_tandem_fleet / _solve_all.)"""
+    out, _ = _solve_all(plan, None, mesh, n_iters, use_pallas)
+    return out
+
+
+def solve_tandem_fleet(
+    plan: TandemPlan,
+    mesh: jax.sharding.Mesh | None = None,
+    n_iters: int = DEFAULT_BISECT_ITERS,
+    use_pallas: bool = False,
+) -> FleetResult:
+    """Run the jitted batched tandem sizing for disaggregated lanes."""
+    _, out = _solve_all(None, plan, mesh, n_iters, use_pallas)
     return out
 
 
@@ -306,51 +465,53 @@ def calculate_fleet(
             alloc.value = transition_penalty(server.cur_allocation, alloc)
             server.all_allocations[acc.name] = alloc
 
-    # disaggregated (prefill/decode tandem) lanes: the batched kernel models
-    # a single mu(n) stage, so these size through the scalar tandem analyzer
-    n_disagg = 0
-    for server_name, server in system.servers.items():
-        load = server.load
-        if load is None or load.arrival_rate <= 0 or load.avg_out_tokens == 0:
-            continue
-        model = system.models.get(server.model_name)
-        if model is None:
-            continue
-        for acc in server.candidate_accelerators(system).values():
-            perf = model.perf_data.get(acc.name)
-            if perf is None or perf.disagg is None:
-                continue
-            alloc = create_allocation(system, server_name, acc.name)
-            if alloc is not None:
-                alloc.value = transition_penalty(server.cur_allocation, alloc)
-                server.all_allocations[acc.name] = alloc
-                n_disagg += 1
-
     plan = build_fleet(system)
+    tandem = build_tandem_fleet(system)
     system.candidates_calculated = True
-    if plan is None:
-        return n_disagg
+    if plan is None and tandem is None:
+        return 0
+
     if backend == "native":
+        # the C++ solver covers aggregated lanes (controller deployments
+        # without a TPU attachment); tandem lanes ride the batched XLA
+        # kernel on whatever backend jax has — still one fused program,
+        # never a per-lane Python loop
         from inferno_tpu.native import fleet_size_native
 
-        result = fleet_size_native(plan.params)
-    else:
-        result = solve_fleet(plan, mesh=mesh, use_pallas=(backend == "tpu-pallas"))
-
-    for i, (server_name, acc_name) in enumerate(plan.lanes):
-        if not bool(result.feasible[i]):
-            continue
-        server = system.servers[server_name]
-        alloc = Allocation(
-            accelerator=acc_name,
-            num_replicas=int(result.num_replicas[i]),
-            batch_size=int(plan.params.max_batch[i]),
-            cost=float(result.cost[i]),
-            itl=float(result.itl[i]),
-            ttft=float(result.ttft[i]),
-            rho=float(result.rho[i]),
-            max_arrv_rate_per_replica=float(result.rate_star[i]) / 1000.0,
+        result = fleet_size_native(plan.params) if plan is not None else None
+        tresult = (
+            solve_tandem_fleet(tandem, mesh=mesh) if tandem is not None else None
         )
-        alloc.value = transition_penalty(server.cur_allocation, alloc)
-        server.all_allocations[acc_name] = alloc
-    return plan.num_lanes + n_disagg
+    else:
+        result, tresult = _solve_all(
+            plan, tandem, mesh, DEFAULT_BISECT_ITERS, backend == "tpu-pallas"
+        )
+
+    def write_back(lanes, result, batch_of):
+        for i, (server_name, acc_name) in enumerate(lanes):
+            if not bool(result.feasible[i]):
+                continue
+            server = system.servers[server_name]
+            alloc = Allocation(
+                accelerator=acc_name,
+                num_replicas=int(result.num_replicas[i]),
+                batch_size=batch_of(i),
+                cost=float(result.cost[i]),
+                itl=float(result.itl[i]),
+                ttft=float(result.ttft[i]),
+                rho=float(result.rho[i]),
+                max_arrv_rate_per_replica=float(result.rate_star[i]) / 1000.0,
+            )
+            alloc.value = transition_penalty(server.cur_allocation, alloc)
+            server.all_allocations[acc_name] = alloc
+
+    n = 0
+    if plan is not None and result is not None:
+        write_back(plan.lanes, result, lambda i: int(plan.params.max_batch[i]))
+        n += plan.num_lanes
+    if tandem is not None and tresult is not None:
+        write_back(
+            tandem.lanes, tresult, lambda i: int(tandem.params.decode_batch[i])
+        )
+        n += tandem.num_lanes
+    return n

@@ -137,3 +137,112 @@ def test_fleet_end_to_end_with_solver():
     assert set(result.solution) == {s.name for s in spec.servers}
     for data in result.solution.values():
         assert data.num_replicas >= 1
+
+
+# -- disaggregated (tandem) lanes on the batched path ------------------------
+
+from inferno_tpu.config import DisaggSpec  # noqa: E402
+from inferno_tpu.parallel import build_tandem_fleet  # noqa: E402
+
+
+def _make_disagg_spec(mixed=False):
+    """Fleet where some/all shapes serve disaggregated (JetStream-style).
+
+    mixed=True keeps v5p-8 aggregated so one system exercises both kernel
+    families in the same fused program."""
+    from fixtures import make_perf, make_server, make_system_spec
+
+    servers = [
+        make_server(name="ns/jet-premium", class_name="Premium", arrival_rate=600.0),
+        make_server(name="ns/jet-freemium", class_name="Freemium",
+                    arrival_rate=2400.0, in_tokens=256, out_tokens=64),
+    ]
+    spec = make_system_spec(servers)
+    for perf in spec.models:
+        if mixed and perf.acc == "v5p-8":
+            continue
+        perf.disagg = DisaggSpec(
+            prefill_slices=1, decode_slices=2,
+            prefill_max_batch=8 if perf.acc == "v5e-4" else 0,
+        )
+    return spec
+
+
+@pytest.mark.parametrize("mixed", [False, True])
+def test_tandem_fleet_matches_scalar_disagg(mixed):
+    """Lane-by-lane parity of the batched tandem kernel vs DisaggAnalyzer
+    (the scalar tandem path), including mixed agg+disagg fleets."""
+    spec = _make_disagg_spec(mixed=mixed)
+    scalar = _scalar_system(spec)
+    fleet = _fleet_system(spec)
+    n_checked = 0
+    for name, s_server in scalar.servers.items():
+        f_server = fleet.servers[name]
+        assert set(f_server.all_allocations) == set(s_server.all_allocations), name
+        for acc, s_alloc in s_server.all_allocations.items():
+            f_alloc = f_server.all_allocations[acc]
+            assert f_alloc.batch_size == s_alloc.batch_size
+            assert abs(f_alloc.num_replicas - s_alloc.num_replicas) <= 1
+            assert f_alloc.max_arrv_rate_per_replica == pytest.approx(
+                s_alloc.max_arrv_rate_per_replica, rel=2e-2
+            )
+            assert f_alloc.itl == pytest.approx(s_alloc.itl, rel=5e-2, abs=0.5)
+            assert f_alloc.ttft == pytest.approx(s_alloc.ttft, rel=5e-2, abs=2.0)
+            assert f_alloc.rho == pytest.approx(s_alloc.rho, rel=5e-2, abs=0.02)
+            assert f_alloc.cost == pytest.approx(s_alloc.cost, rel=1e-5)
+            n_checked += 1
+    assert n_checked >= 4
+
+
+def test_tandem_plan_shapes():
+    spec = _make_disagg_spec(mixed=True)
+    system = System(spec)
+    agg = build_fleet(system)
+    tan = build_tandem_fleet(system)
+    assert agg.num_lanes == 2  # v5p-8 stays aggregated, 2 servers
+    assert tan.num_lanes == 4  # v5e-4 + v5e-16 disagg, 2 servers
+    # disagg unit footprint: slices_per_replica * (prefill + decode slices)
+    assert np.all(np.asarray(tan.params.cost_per_replica) > 0)
+    # v5e-4 lane uses the prefill_max_batch override
+    i = tan.lanes.index(("ns/jet-premium", "v5e-4"))
+    assert int(tan.params.prefill_batch[i]) == 8
+    assert int(tan.params.decode_batch[i]) > 8
+
+
+def test_tandem_sharded_over_mesh_matches_unsharded():
+    spec = _make_disagg_spec(mixed=True)
+    plain = _fleet_system(spec)
+    sharded = _fleet_system(spec, mesh=fleet_mesh())
+    for name, p_server in plain.servers.items():
+        s_server = sharded.servers[name]
+        assert set(p_server.all_allocations) == set(s_server.all_allocations)
+        for acc in p_server.all_allocations:
+            assert (
+                p_server.all_allocations[acc].num_replicas
+                == s_server.all_allocations[acc].num_replicas
+            )
+
+
+def test_tandem_infeasible_target_excluded():
+    spec = _make_disagg_spec()
+    for sc in spec.service_classes:
+        sc.model_targets[0] = type(sc.model_targets[0])(
+            model=sc.model_targets[0].model, slo_itl=1.0, slo_ttft=0.0, slo_tps=0.0
+        )
+    fleet = _fleet_system(spec)
+    scalar = _scalar_system(spec)
+    for name, server in fleet.servers.items():
+        assert server.all_allocations == {}
+        assert scalar.servers[name].all_allocations == {}
+
+
+def test_tandem_no_prefill_stage_excluded():
+    """in_tokens == 0 is invalid for the tandem model (scalar raises and
+    rejects the lane); the batched path must agree."""
+    spec = _make_disagg_spec()
+    for srv in spec.servers:
+        srv.current_alloc.load.avg_in_tokens = 0
+    fleet = _fleet_system(spec)
+    scalar = _scalar_system(spec)
+    for name, server in fleet.servers.items():
+        assert server.all_allocations == scalar.servers[name].all_allocations == {}

@@ -141,3 +141,94 @@ def test_cap_beyond_grid_is_truncated():
         r, g = np.asarray(r), np.asarray(g)
         assert np.all(np.isfinite(r)) and np.all(np.isfinite(g))
         assert np.allclose(r, g, rtol=5e-3, atol=1e-4)
+
+
+def _tandem_params(P, rng):
+    def arr(lo, hi):
+        return jnp.asarray(rng.uniform(lo, hi, P), jnp.float32)
+
+    pb = rng.integers(4, 16, P)
+    db = rng.integers(8, 24, P)
+    mq = db * 10
+    return q.TandemParams(
+        alpha=arr(5, 25),
+        beta=arr(0.1, 0.5),
+        gamma=arr(2, 8),
+        delta=arr(0.005, 0.03),
+        # integral so the scalar cross-check sees identical request shapes
+        in_tokens=jnp.asarray(rng.integers(64, 512, P), jnp.float32),
+        out_tokens=jnp.asarray(rng.integers(32, 256, P), jnp.float32),
+        prefill_batch=jnp.asarray(pb, jnp.int32),
+        decode_batch=jnp.asarray(db, jnp.int32),
+        prefill_cap=jnp.asarray(pb + mq, jnp.int32),
+        decode_cap=jnp.asarray(db + mq, jnp.int32),
+        prefill_slices=jnp.asarray(rng.integers(1, 3, P), jnp.float32),
+        decode_slices=jnp.asarray(rng.integers(1, 4, P), jnp.float32),
+        target_ttft=arr(200, 900),
+        target_itl=arr(15, 40),
+        target_tps=jnp.zeros(P),
+        total_rate=arr(0.5, 30),
+        min_replicas=jnp.ones(P, jnp.int32),
+        cost_per_replica=arr(1, 10),
+    )
+
+
+def test_tandem_size_pallas_matches_xla():
+    rng = np.random.default_rng(11)
+    params = _tandem_params(16, rng)
+    r_xla = q.tandem_fleet_size(params, 256, use_pallas=False)
+    r_pal = q.tandem_fleet_size(params, 256, use_pallas=True)
+    assert np.array_equal(np.asarray(r_xla.feasible), np.asarray(r_pal.feasible))
+    assert np.array_equal(
+        np.asarray(r_xla.num_replicas), np.asarray(r_pal.num_replicas)
+    )
+    assert np.allclose(
+        np.asarray(r_xla.rate_star), np.asarray(r_pal.rate_star), rtol=1e-2
+    )
+
+
+def test_tandem_kernel_against_scalar_analyzer():
+    """Ground truth: the float64 DisaggAnalyzer, lane by lane."""
+    from inferno_tpu.analyzer import TargetPerf, build_disagg_analyzer
+    from inferno_tpu.config.types import DisaggSpec
+
+    rng = np.random.default_rng(3)
+    P = 12
+    params = _tandem_params(P, rng)
+    res = q.tandem_fleet_size(params, 256)
+    pn = {k: np.asarray(v) for k, v in params._asdict().items()}
+    for i in range(P):
+        qa = build_disagg_analyzer(
+            max_batch=int(pn["decode_batch"][i]),
+            max_queue=int(pn["decode_cap"][i] - pn["decode_batch"][i]),
+            decode=DecodeParms(alpha=float(pn["alpha"][i]), beta=float(pn["beta"][i])),
+            prefill=PrefillParms(
+                gamma=float(pn["gamma"][i]), delta=float(pn["delta"][i])
+            ),
+            request=RequestSize(
+                avg_in_tokens=int(pn["in_tokens"][i]),
+                avg_out_tokens=int(pn["out_tokens"][i]),
+            ),
+            spec=DisaggSpec(
+                prefill_slices=int(pn["prefill_slices"][i]),
+                decode_slices=int(pn["decode_slices"][i]),
+                prefill_max_batch=int(pn["prefill_batch"][i]),
+            ),
+        )
+        targets = TargetPerf(
+            target_ttft=float(pn["target_ttft"][i]),
+            target_itl=float(pn["target_itl"][i]),
+        )
+        try:
+            rates, metrics, _ = qa.size(targets)
+            feasible = True
+        except Exception:
+            feasible = False
+        assert bool(res.feasible[i]) == feasible, i
+        if not feasible:
+            continue
+        lam_star = min(rates.rate_target_ttft, rates.rate_target_itl) / 1000.0
+        assert float(res.lambda_star[i]) == pytest.approx(lam_star, rel=2e-2), i
+        assert float(res.rate_star[i]) == pytest.approx(
+            metrics.throughput, rel=2e-2
+        ), i
