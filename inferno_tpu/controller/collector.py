@@ -29,7 +29,9 @@ from inferno_tpu.controller.promclient import PromClient, PromError, Sample
 
 STALENESS_LIMIT_SECONDS = 300.0  # 5 min (reference: collector.go:139-149)
 
-# reference hardcodes 256 pending server-reported value (collector.go:257-259)
+# Last-resort fallback only: the collector prefers the engine-reported max
+# batch, then the CR profile's maxBatchSize (the reference hardcodes this
+# 256 with a TODO, collector.go:257-259 — that wart is fixed here).
 DEFAULT_MAX_BATCH = 256
 
 
@@ -109,6 +111,42 @@ def validate_metrics_availability(
     )
 
 
+def _observed_max_batch(
+    prom: PromClient,
+    engine: EngineMetrics,
+    model: str,
+    ns: str,
+    va: VariantAutoscaling,
+    accelerator: str,
+) -> int:
+    """Max concurrent batch for CurrentAlloc, in preference order: the
+    engine-reported series (per-replica max, so `max()` across pods), the
+    CR profile's maxBatchSize for the current slice shape, then the
+    constant fallback. Replaces the reference's hardcoded 256
+    (collector.go:257-259)."""
+    if engine.max_batch_metric:
+        try:
+            samples = prom.query(
+                f"max({engine.max_batch_metric}{_selector(engine, model, ns)})"
+            )
+        except PromError:
+            samples = []  # batch is advisory; never fail the collection over it
+        if not samples:
+            try:
+                samples = prom.query(
+                    f"max({engine.max_batch_metric}{_selector(engine, model, None)})"
+                )
+            except PromError:
+                samples = []
+        value = int(_first_value(samples))
+        if value > 0:
+            return value
+    for prof in va.spec.accelerators:
+        if prof.acc == accelerator and prof.max_batch_size > 0:
+            return prof.max_batch_size
+    return DEFAULT_MAX_BATCH
+
+
 def collect_current_alloc(
     prom: PromClient,
     engine: EngineMetrics,
@@ -147,7 +185,7 @@ def collect_current_alloc(
     return CurrentAlloc(
         accelerator=accelerator,
         num_replicas=replicas,
-        max_batch=DEFAULT_MAX_BATCH,
+        max_batch=_observed_max_batch(prom, engine, model, ns, va, accelerator),
         variant_cost=replicas * accelerator_cost,
         itl_average=itl_ms,
         ttft_average=ttft_ms,

@@ -30,6 +30,10 @@ class EngineMetrics:
     ttft_seconds_count: str
     tpot_seconds_sum: str
     tpot_seconds_count: str
+    # engine-reported max concurrent requests; "" = engine doesn't expose one
+    # (the reference hardcodes 256 with a TODO, collector.go:257-259 — here
+    # the collector prefers the live engine value, then the CR profile)
+    max_batch_metric: str = ""
     model_label: str = LABEL_MODEL_NAME
 
 
@@ -46,6 +50,7 @@ VLLM_TPU = EngineMetrics(
     ttft_seconds_count="vllm:time_to_first_token_seconds_count",
     tpot_seconds_sum="vllm:time_per_output_token_seconds_sum",
     tpot_seconds_count="vllm:time_per_output_token_seconds_count",
+    max_batch_metric="vllm:num_requests_max",
 )
 
 JETSTREAM = EngineMetrics(
@@ -60,6 +65,7 @@ JETSTREAM = EngineMetrics(
     ttft_seconds_count="jetstream_time_to_first_token_count",
     tpot_seconds_sum="jetstream_time_per_output_token_sum",
     tpot_seconds_count="jetstream_time_per_output_token_count",
+    max_batch_metric="jetstream_total_slots",
     model_label="id",
 )
 

@@ -59,25 +59,28 @@ class TandemPlan:
         return len(self.lanes)
 
 
-def build_fleet(system: System) -> FleetPlan | None:
-    """Flatten all loaded (server, slice-shape) pairs into a FleetParams.
+@dataclasses.dataclass(frozen=True)
+class _LaneBasis:
+    """One eligible (server, slice shape) pair with everything both
+    builders derive from the scalar create_allocation preamble."""
 
-    Zero-load servers are excluded (handled by the closed-form shortcut in
-    `calculate_fleet`). Mesh padding happens per occupancy bucket in
-    `solve_fleet`, not here.
-    """
-    cols: dict[str, list] = {
-        "alpha": [], "beta": [], "gamma": [], "delta": [],
-        "in_tokens": [], "out_tokens": [], "max_batch": [], "occupancy_cap": [],
-        "target_ttft": [], "target_itl": [], "target_tps": [],
-        "total_rate": [], "min_replicas": [], "cost_per_replica": [],
-    }
-    lanes: list[tuple[str, str]] = []
+    server_name: str
+    acc_name: str
+    perf: object
+    target: object
+    load: object
+    batch: int  # output-length-scaled batch (allocation.py:117-121)
+    cost_per_replica: float
+    min_replicas: int
 
+
+def _eligible_lanes(system: System):
+    """Yield the lanes the scalar create_allocation would size: shared
+    eligibility walk for the aggregated and tandem builders so their
+    candidate sets cannot diverge. Zero-load servers are excluded
+    (handled by the closed-form shortcut in `calculate_fleet`)."""
     for server_name, server in system.servers.items():
         load = server.load
-        # same eligibility guards as the scalar create_allocation
-        # (core/allocation.py): invalid loads produce no candidates
         if load is None or load.arrival_rate < 0:
             continue
         if load.avg_in_tokens < 0 or load.avg_out_tokens < 0:
@@ -95,65 +98,80 @@ def build_fleet(system: System) -> FleetPlan | None:
             perf = model.perf_data.get(acc.name)
             if perf is None:
                 continue
-            if perf.disagg is not None:
-                continue  # tandem lanes are batched by build_tandem_fleet
-            # non-positive service time => the scalar analyzer raises and
-            # the pair is rejected; keep the batched path consistent
-            nd = load.avg_out_tokens - 1
-            if load.avg_in_tokens == 0 and load.avg_out_tokens == 1:
-                nd = 1
-            t1 = nd * (perf.decode_parms.alpha + perf.decode_parms.beta)
-            if load.avg_in_tokens > 0:
-                t1 += (
-                    perf.prefill_parms.gamma
-                    + perf.prefill_parms.delta * load.avg_in_tokens
-                )
-            if t1 <= 0:
-                continue
             k_out = load.avg_out_tokens
             if server.max_batch_size > 0:
                 batch = server.max_batch_size
             else:
                 batch = max(perf.max_batch_size * perf.at_tokens // k_out, 1)
-            cols["alpha"].append(perf.decode_parms.alpha)
-            cols["beta"].append(perf.decode_parms.beta)
-            cols["gamma"].append(perf.prefill_parms.gamma)
-            cols["delta"].append(perf.prefill_parms.delta)
-            cols["in_tokens"].append(float(load.avg_in_tokens))
-            cols["out_tokens"].append(float(k_out))
-            cols["max_batch"].append(batch)
-            cols["occupancy_cap"].append(batch * (1 + MAX_QUEUE_TO_BATCH_RATIO))
-            cols["target_ttft"].append(target.slo_ttft)
-            cols["target_itl"].append(target.slo_itl)
-            cols["target_tps"].append(target.slo_tps)
-            cols["total_rate"].append(load.arrival_rate / 60.0)
-            cols["min_replicas"].append(max(server.min_num_replicas, 0))
-            cols["cost_per_replica"].append(
-                acc.cost * model.slices_per_replica(acc.name)
+            yield _LaneBasis(
+                server_name=server_name,
+                acc_name=acc.name,
+                perf=perf,
+                target=target,
+                load=load,
+                batch=batch,
+                cost_per_replica=acc.cost * model.slices_per_replica(acc.name),
+                min_replicas=max(server.min_num_replicas, 0),
             )
-            lanes.append((server_name, acc.name))
+
+
+def _pack(cls, cols: dict[str, list], int_fields: frozenset[str]):
+    return cls(
+        **{
+            name: np.asarray(cols[name], np.int32 if name in int_fields else np.float32)
+            for name in cls._fields
+        }
+    )
+
+
+def _shared_cols(cols: dict[str, list], lane: _LaneBasis) -> None:
+    cols["alpha"].append(lane.perf.decode_parms.alpha)
+    cols["beta"].append(lane.perf.decode_parms.beta)
+    cols["gamma"].append(lane.perf.prefill_parms.gamma)
+    cols["delta"].append(lane.perf.prefill_parms.delta)
+    cols["in_tokens"].append(float(lane.load.avg_in_tokens))
+    cols["out_tokens"].append(float(lane.load.avg_out_tokens))
+    cols["target_ttft"].append(lane.target.slo_ttft)
+    cols["target_itl"].append(lane.target.slo_itl)
+    cols["target_tps"].append(lane.target.slo_tps)
+    cols["total_rate"].append(lane.load.arrival_rate / 60.0)
+    cols["min_replicas"].append(lane.min_replicas)
+    cols["cost_per_replica"].append(lane.cost_per_replica)
+
+
+def build_fleet(system: System) -> FleetPlan | None:
+    """Flatten all loaded aggregated (server, slice-shape) pairs into a
+    FleetParams. Mesh padding happens per occupancy bucket in
+    `solve_fleet`, not here."""
+    cols: dict[str, list] = {name: [] for name in FleetParams._fields}
+    lanes: list[tuple[str, str]] = []
+
+    for lane in _eligible_lanes(system):
+        perf, load = lane.perf, lane.load
+        if perf.disagg is not None:
+            continue  # tandem lanes are batched by build_tandem_fleet
+        # non-positive service time => the scalar analyzer raises and
+        # the pair is rejected; keep the batched path consistent
+        nd = load.avg_out_tokens - 1
+        if load.avg_in_tokens == 0 and load.avg_out_tokens == 1:
+            nd = 1
+        t1 = nd * (perf.decode_parms.alpha + perf.decode_parms.beta)
+        if load.avg_in_tokens > 0:
+            t1 += (
+                perf.prefill_parms.gamma
+                + perf.prefill_parms.delta * load.avg_in_tokens
+            )
+        if t1 <= 0:
+            continue
+        _shared_cols(cols, lane)
+        cols["max_batch"].append(lane.batch)
+        cols["occupancy_cap"].append(lane.batch * (1 + MAX_QUEUE_TO_BATCH_RATIO))
+        lanes.append((lane.server_name, lane.acc_name))
 
     if not lanes:
         return None
-
-    def col(name, dtype):
-        return np.asarray(cols[name], dtype=dtype)
-
-    params = FleetParams(
-        alpha=col("alpha", np.float32),
-        beta=col("beta", np.float32),
-        gamma=col("gamma", np.float32),
-        delta=col("delta", np.float32),
-        in_tokens=col("in_tokens", np.float32),
-        out_tokens=col("out_tokens", np.float32),
-        max_batch=col("max_batch", np.int32),
-        occupancy_cap=col("occupancy_cap", np.int32),
-        target_ttft=col("target_ttft", np.float32),
-        target_itl=col("target_itl", np.float32),
-        target_tps=col("target_tps", np.float32),
-        total_rate=col("total_rate", np.float32),
-        min_replicas=col("min_replicas", np.int32),
-        cost_per_replica=col("cost_per_replica", np.float32),
+    params = _pack(
+        FleetParams, cols, frozenset(("max_batch", "occupancy_cap", "min_replicas"))
     )
     return FleetPlan(params=params, lanes=lanes)
 
@@ -164,105 +182,54 @@ def build_tandem_fleet(system: System) -> TandemPlan | None:
     (create_allocation + build_disagg_analyzer): lanes the scalar analyzer
     would reject (no prefill stage, invalid spec, non-positive stage
     times) produce no candidate here either."""
-    cols: dict[str, list] = {
-        "alpha": [], "beta": [], "gamma": [], "delta": [],
-        "in_tokens": [], "out_tokens": [],
-        "prefill_batch": [], "decode_batch": [], "prefill_cap": [], "decode_cap": [],
-        "prefill_slices": [], "decode_slices": [],
-        "target_ttft": [], "target_itl": [], "target_tps": [],
-        "total_rate": [], "min_replicas": [], "cost_per_replica": [],
-    }
+    cols: dict[str, list] = {name: [] for name in TandemParams._fields}
     lanes: list[tuple[str, str]] = []
 
-    for server_name, server in system.servers.items():
-        load = server.load
-        if load is None or load.arrival_rate <= 0:
+    for lane in _eligible_lanes(system):
+        perf, load = lane.perf, lane.load
+        if perf.disagg is None:
             continue
-        if load.avg_in_tokens <= 0 or load.avg_out_tokens <= 0:
-            # the tandem model requires a prefill stage (disagg.py validates
-            # avg_in_tokens > 0); zero-load handled by the shortcut
+        if load.avg_in_tokens <= 0:
+            # the tandem model requires a prefill stage (disagg.py
+            # validates avg_in_tokens > 0)
             continue
-        model = system.models.get(server.model_name)
-        svc = system.service_classes.get(server.service_class_name)
-        if model is None or svc is None:
+        dg = perf.disagg
+        try:
+            dg.validate()
+        except ValueError:
             continue
-        target = svc.target_for(server.model_name)
-        if target is None:
+        batch = lane.batch
+        max_queue = batch * MAX_QUEUE_TO_BATCH_RATIO
+        p_batch = dg.prefill_max_batch or batch
+        # non-positive stage times => scalar analyzer raises; reject here
+        nd = max(load.avg_out_tokens - 1, 1)
+        pf = perf.prefill_parms
+        dc = perf.decode_parms
+        p_times = (
+            pf.gamma + pf.delta * load.avg_in_tokens,
+            pf.gamma + pf.delta * load.avg_in_tokens * p_batch,
+        )
+        d_times = (dc.alpha + dc.beta, dc.alpha + dc.beta * batch)
+        if min(p_times) <= 0 or nd * min(d_times) <= 0:
             continue
-        for acc in server.candidate_accelerators(system).values():
-            perf = model.perf_data.get(acc.name)
-            if perf is None or perf.disagg is None:
-                continue
-            dg = perf.disagg
-            try:
-                dg.validate()
-            except ValueError:
-                continue
-            k_out = load.avg_out_tokens
-            if server.max_batch_size > 0:
-                batch = server.max_batch_size
-            else:
-                batch = max(perf.max_batch_size * perf.at_tokens // k_out, 1)
-            max_queue = batch * MAX_QUEUE_TO_BATCH_RATIO
-            p_batch = dg.prefill_max_batch or batch
-            # non-positive stage times => scalar analyzer raises; reject here
-            nd = max(k_out - 1, 1)
-            pf = perf.prefill_parms
-            dc = perf.decode_parms
-            p_times = (
-                pf.gamma + pf.delta * load.avg_in_tokens,
-                pf.gamma + pf.delta * load.avg_in_tokens * p_batch,
-            )
-            d_times = (dc.alpha + dc.beta, dc.alpha + dc.beta * batch)
-            if min(p_times) <= 0 or nd * min(d_times) <= 0:
-                continue
-            cols["alpha"].append(dc.alpha)
-            cols["beta"].append(dc.beta)
-            cols["gamma"].append(pf.gamma)
-            cols["delta"].append(pf.delta)
-            cols["in_tokens"].append(float(load.avg_in_tokens))
-            cols["out_tokens"].append(float(k_out))
-            cols["prefill_batch"].append(p_batch)
-            cols["decode_batch"].append(batch)
-            cols["prefill_cap"].append(p_batch + max_queue)
-            cols["decode_cap"].append(batch + max_queue)
-            cols["prefill_slices"].append(float(dg.prefill_slices))
-            cols["decode_slices"].append(float(dg.decode_slices))
-            cols["target_ttft"].append(target.slo_ttft)
-            cols["target_itl"].append(target.slo_itl)
-            cols["target_tps"].append(target.slo_tps)
-            cols["total_rate"].append(load.arrival_rate / 60.0)
-            cols["min_replicas"].append(max(server.min_num_replicas, 0))
-            cols["cost_per_replica"].append(
-                acc.cost * model.slices_per_replica(acc.name)
-            )
-            lanes.append((server_name, acc.name))
+        _shared_cols(cols, lane)
+        cols["prefill_batch"].append(p_batch)
+        cols["decode_batch"].append(batch)
+        cols["prefill_cap"].append(p_batch + max_queue)
+        cols["decode_cap"].append(batch + max_queue)
+        cols["prefill_slices"].append(float(dg.prefill_slices))
+        cols["decode_slices"].append(float(dg.decode_slices))
+        lanes.append((lane.server_name, lane.acc_name))
 
     if not lanes:
         return None
-
-    def col(name, dtype):
-        return np.asarray(cols[name], dtype=dtype)
-
-    params = TandemParams(
-        alpha=col("alpha", np.float32),
-        beta=col("beta", np.float32),
-        gamma=col("gamma", np.float32),
-        delta=col("delta", np.float32),
-        in_tokens=col("in_tokens", np.float32),
-        out_tokens=col("out_tokens", np.float32),
-        prefill_batch=col("prefill_batch", np.int32),
-        decode_batch=col("decode_batch", np.int32),
-        prefill_cap=col("prefill_cap", np.int32),
-        decode_cap=col("decode_cap", np.int32),
-        prefill_slices=col("prefill_slices", np.float32),
-        decode_slices=col("decode_slices", np.float32),
-        target_ttft=col("target_ttft", np.float32),
-        target_itl=col("target_itl", np.float32),
-        target_tps=col("target_tps", np.float32),
-        total_rate=col("total_rate", np.float32),
-        min_replicas=col("min_replicas", np.int32),
-        cost_per_replica=col("cost_per_replica", np.float32),
+    params = _pack(
+        TandemParams,
+        cols,
+        frozenset(
+            ("prefill_batch", "decode_batch", "prefill_cap", "decode_cap",
+             "min_replicas")
+        ),
     )
     return TandemPlan(params=params, lanes=lanes)
 
@@ -409,7 +376,7 @@ def solve_fleet(
     """Run the jitted batched sizing for aggregated lanes; optionally shard
     lanes over a mesh. (Tandem lanes: see solve_tandem_fleet / _solve_all.)"""
     out, _ = _solve_all(plan, None, mesh, n_iters, use_pallas)
-    return out
+    return out if out is not None else _empty_result(0)
 
 
 def solve_tandem_fleet(
@@ -420,7 +387,7 @@ def solve_tandem_fleet(
 ) -> FleetResult:
     """Run the jitted batched tandem sizing for disaggregated lanes."""
     _, out = _solve_all(None, plan, mesh, n_iters, use_pallas)
-    return out
+    return out if out is not None else _empty_result(0)
 
 
 def calculate_fleet(

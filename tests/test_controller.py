@@ -331,3 +331,51 @@ def test_metrics_tls_half_config_fails_loudly(monkeypatch):
         TLSConfig.from_env()
     monkeypatch.delenv("METRICS_TLS_KEY_PATH", raising=False)
     assert TLSConfig.from_env() is None
+
+
+def test_current_alloc_max_batch_from_engine():
+    """The engine-reported max batch wins (the reference's hardcoded-256
+    TODO at collector.go:257-259, fixed): vllm:num_requests_max scraped
+    via max() across pods."""
+    cluster = make_cluster(replicas=1)
+    prom = make_prom(arrival_rps=50.0)
+    # FakeProm dispatches to the FIRST matching handler; make_prom installed
+    # a catch-all, so the engine series handler must take precedence
+    prom.handlers.insert(
+        0,
+        (
+            lambda q: "num_requests_max" in q,
+            lambda q: [Sample(labels={}, value=48.0, timestamp=_time.time())],
+        ),
+    )
+    rec = reconciler(cluster, prom)
+    rec.run_cycle()
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    assert va.status.current_alloc.max_batch == 48
+
+
+def test_current_alloc_max_batch_falls_back_to_profile():
+    """Engine doesn't expose a max-batch series: the CR profile for the
+    current slice shape supplies it (v5e-4 profile: 64)."""
+    cluster = make_cluster(replicas=1)
+    rec = reconciler(cluster, make_prom(arrival_rps=50.0))
+    rec.run_cycle()
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    assert va.status.current_alloc.max_batch == 64
+
+
+def test_current_alloc_max_batch_last_resort_constant():
+    """No engine series and no matching profile: the constant fallback."""
+    from inferno_tpu.controller.collector import (
+        DEFAULT_MAX_BATCH,
+        _observed_max_batch,
+    )
+    from inferno_tpu.controller.engines import engine_for
+
+    cluster = make_cluster(replicas=1)
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    got = _observed_max_batch(
+        make_prom(), engine_for("vllm-tpu"), va.spec.model_id, NS, va,
+        accelerator="unknown-shape",
+    )
+    assert got == DEFAULT_MAX_BATCH
