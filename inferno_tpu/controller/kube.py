@@ -75,6 +75,7 @@ class InMemoryCluster:
         self.default_namespace = namespace
         self._vas: dict[tuple[str, str], dict] = {}
         self._deployments: dict[tuple[str, str], dict] = {}
+        self._lws: dict[tuple[str, str], dict] = {}
         self._configmaps: dict[tuple[str, str], dict[str, str]] = {}
         self._nodes: dict[str, dict] = {}
         self._leases: dict[tuple[str, str], dict] = {}
@@ -106,6 +107,53 @@ class InMemoryCluster:
             "spec": {"replicas": replicas},
             "status": {"readyReplicas": replicas, "replicas": replicas},
         }
+
+    def add_leader_worker_set(
+        self,
+        namespace: str,
+        name: str,
+        replicas: int = 1,
+        size: int = 4,
+        labels: dict | None = None,
+    ) -> None:
+        """A LeaderWorkerSet: `replicas` pod GROUPS of `size` pods each
+        (one pod per host of a multi-host slice). Pods are accounted
+        atomically: a group exists completely or not at all."""
+        self._lws[(namespace, name)] = {
+            "apiVersion": "leaderworkerset.x-k8s.io/v1",
+            "kind": "LeaderWorkerSet",
+            "metadata": {"name": name, "namespace": namespace, "labels": labels or {}},
+            "spec": {"replicas": replicas, "leaderWorkerTemplate": {"size": size}},
+            "status": {"readyReplicas": replicas, "replicas": replicas},
+        }
+
+    def get_leader_worker_set(self, namespace: str, name: str) -> dict:
+        d = self._lws.get((namespace, name))
+        if d is None:
+            raise NotFound(f"leaderworkerset {namespace}/{name}")
+        return copy.deepcopy(d)
+
+    def scale_leader_worker_set(self, namespace: str, name: str, replicas: int) -> None:
+        d = self._lws.get((namespace, name))
+        if d is None:
+            raise NotFound(f"leaderworkerset {namespace}/{name}")
+        d["spec"]["replicas"] = replicas
+        d["status"]["replicas"] = replicas
+        d["status"]["readyReplicas"] = replicas
+        self._notify("LeaderWorkerSet", "MODIFIED", namespace, name)
+
+    def pod_count(self, namespace: str, name: str) -> int:
+        """Observable pod count of a workload — for a LeaderWorkerSet
+        always groups x size (whole groups only)."""
+        lws = self._lws.get((namespace, name))
+        if lws is not None:
+            return int(lws["spec"]["replicas"]) * int(
+                lws["spec"]["leaderWorkerTemplate"]["size"]
+            )
+        dep = self._deployments.get((namespace, name))
+        if dep is not None:
+            return int(dep["spec"]["replicas"])
+        raise NotFound(f"workload {namespace}/{name}")
 
     def set_configmap(self, namespace: str, name: str, data: dict[str, str]) -> None:
         event = "MODIFIED" if (namespace, name) in self._configmaps else "ADDED"
@@ -365,6 +413,27 @@ class RestKubeClient:
             lambda: self._request(
                 "PATCH",
                 f"/apis/apps/v1/namespaces/{namespace}/deployments/{name}/scale",
+                {"spec": {"replicas": replicas}},
+                content_type="application/merge-patch+json",
+            )
+        )
+
+    def get_leader_worker_set(self, namespace: str, name: str) -> dict:
+        return with_backoff(
+            lambda: self._request(
+                "GET",
+                f"/apis/leaderworkerset.x-k8s.io/v1/namespaces/{namespace}"
+                f"/leaderworkersets/{name}",
+            )
+        )
+
+    def scale_leader_worker_set(self, namespace: str, name: str, replicas: int) -> None:
+        # LWS serves the scale subresource; spec.replicas counts GROUPS
+        with_backoff(
+            lambda: self._request(
+                "PATCH",
+                f"/apis/leaderworkerset.x-k8s.io/v1/namespaces/{namespace}"
+                f"/leaderworkersets/{name}/scale",
                 {"spec": {"replicas": replicas}},
                 content_type="application/merge-patch+json",
             )
