@@ -1,7 +1,7 @@
 from inferno_tpu.emulator.engine import EmulatedEngine, EngineProfile, RequestResult
 from inferno_tpu.emulator.loadgen import LoadGenerator, RateSpec
-from inferno_tpu.emulator.prom import EmulatorProm
-from inferno_tpu.emulator.server import EmulatorServer
+from inferno_tpu.emulator.miniprom import MiniProm, MiniPromClient
+from inferno_tpu.emulator.server import EmulatorServer, render_engine_metrics
 
 __all__ = [
     "EmulatedEngine",
@@ -9,6 +9,8 @@ __all__ = [
     "RequestResult",
     "LoadGenerator",
     "RateSpec",
-    "EmulatorProm",
+    "MiniProm",
+    "MiniPromClient",
     "EmulatorServer",
+    "render_engine_metrics",
 ]

@@ -82,7 +82,9 @@ class MiniProm:
     ):
         # each target: "url" or ("url", {extra labels}) — extra labels play
         # the role of Prometheus target relabeling (e.g. the namespace label
-        # a ServiceMonitor attaches to every series of a scraped pod)
+        # a ServiceMonitor attaches to every series of a scraped pod). A
+        # target may also be a zero-arg callable returning exposition text
+        # (in-process engines, no sockets).
         self.targets = [t if isinstance(t, tuple) else (t, {}) for t in targets]
         self.scrape_interval = scrape_interval
         self.window_seconds = window_seconds
@@ -126,11 +128,19 @@ class MiniProm:
             targets = list(self.targets)
         now = time.time()
         for target, extra in targets:
-            try:
-                with urllib.request.urlopen(target, timeout=5) as resp:
-                    text = resp.read().decode()
-            except OSError:
-                continue
+            if callable(target):
+                try:
+                    text = target()
+                except Exception:
+                    # a failing in-process target is a failed scrape, not a
+                    # dead scraper thread
+                    continue
+            else:
+                try:
+                    with urllib.request.urlopen(target, timeout=5) as resp:
+                        text = resp.read().decode()
+                except OSError:
+                    continue
             series = parse_exposition(text)
             with self.lock:
                 for name, labels, value in series:
@@ -202,7 +212,8 @@ class MiniProm:
                 targets = list(self.targets)
             return vector(
                 [
-                    {"metric": {"instance": t}, "value": [now, "1"]}
+                    {"metric": {"instance": t if isinstance(t, str) else getattr(t, "__name__", "in-process")},
+                     "value": [now, "1"]}
                     for t, _ in targets
                 ]
             )
@@ -229,3 +240,61 @@ class MiniProm:
             t, v = hist[-1]
             results.append({"metric": labels, "value": [t, str(v)]})
         return vector(results)
+
+    # -- in-process use ------------------------------------------------------
+
+    def client(self) -> "MiniPromClient":
+        """A socketless PromClient over this MiniProm: queries evaluate
+        directly against the scrape history (same evaluator the HTTP
+        endpoint uses), for tests that wire the collector in-process."""
+        return MiniPromClient(self)
+
+    @classmethod
+    def for_engines(
+        cls,
+        engines: dict,
+        vocab=None,
+        labels: dict | None = None,
+        scrape_interval: float = 0.25,
+        window_seconds: float = 60.0,
+    ) -> "MiniProm":
+        """MiniProm scraping in-process EmulatedEngines — the cluster-free
+        replacement for the former EmulatorProm, minus its substring query
+        matching: engines' metrics are rendered through the real exposition
+        path and queried through the real PromQL-shape evaluator.
+
+        engines: model_id -> list of replica engines (or one engine).
+        """
+        from inferno_tpu.controller.engines import engine_for
+        from inferno_tpu.emulator.server import render_engine_metrics
+
+        vocab = vocab or engine_for("vllm-tpu")
+        targets = []
+        for model_id, replicas in engines.items():
+            if not isinstance(replicas, (list, tuple)):
+                replicas = [replicas]
+            for i, engine in enumerate(replicas):
+                target = lambda e=engine, m=model_id: render_engine_metrics(e, m, vocab)  # noqa: E731
+                target.__name__ = f"{model_id}/{i}"  # `up` instance label
+                targets.append((target, dict(labels or {})))
+        return cls(targets, scrape_interval=scrape_interval, window_seconds=window_seconds)
+
+
+class MiniPromClient:
+    """PromClient adapter over MiniProm.evaluate (no sockets)."""
+
+    def __init__(self, prom: MiniProm):
+        self.prom = prom
+
+    def query(self, promql: str):
+        from inferno_tpu.controller.promclient import Sample
+
+        doc = self.prom.evaluate(promql)
+        out = []
+        for item in doc.get("data", {}).get("result", []):
+            ts, val = item["value"]
+            out.append(Sample(labels=dict(item["metric"]), value=float(val), timestamp=float(ts)))
+        return out
+
+    def healthy(self) -> bool:
+        return True

@@ -116,34 +116,38 @@ class EmulatorServer:
         self.engine.stop()
 
     def render_metrics(self) -> str:
-        """Prometheus exposition in the configured engine vocabulary
-        (name-compatible with real servers, like the reference emulator's
-        metrics.py)."""
-        v = self.vocab
-        e = self.engine
-        label = f'{{{v.model_label}="{self.model_id}"}}'
-        now = time.time()
-        window = [r for (t, r) in list(e.completions) if t >= now - 3600]
-        lines = [
-            f"# TYPE {v.num_requests_running} gauge",
-            f"{v.num_requests_running}{label} {e.num_running}",
-            f"# TYPE {v.request_success_total} counter",
-            f"{v.request_success_total}{label} {len(e.completions)}",
-            f"# TYPE {v.prompt_tokens_sum} counter",
-            f"{v.prompt_tokens_sum}{label} {sum(r.in_tokens for r in window)}",
-            f"{v.prompt_tokens_count}{label} {len(window)}",
-            f"# TYPE {v.generation_tokens_sum} counter",
-            f"{v.generation_tokens_sum}{label} {sum(r.out_tokens for r in window)}",
-            f"{v.generation_tokens_count}{label} {len(window)}",
-            f"# TYPE {v.ttft_seconds_sum} counter",
-            f"{v.ttft_seconds_sum}{label} {sum(r.ttft_ms for r in window) / 1000.0}",
-            f"{v.ttft_seconds_count}{label} {len(window)}",
-            f"# TYPE {v.tpot_seconds_sum} counter",
-            f"{v.tpot_seconds_sum}{label} "
-            f"{sum((r.latency_ms - r.ttft_ms) / max(r.out_tokens - 1, 1) for r in window) / 1000.0}",
-            f"{v.tpot_seconds_count}{label} {len(window)}",
-        ]
-        return "\n".join(lines) + "\n"
+        return render_engine_metrics(self.engine, self.model_id, self.vocab)
+
+
+def render_engine_metrics(e: EmulatedEngine, model_id: str, vocab) -> str:
+    """Prometheus exposition for one engine in the given vocabulary
+    (name-compatible with real servers, like the reference emulator's
+    metrics.py). Shared by the HTTP server and MiniProm's in-process
+    scrape targets."""
+    v = vocab
+    label = f'{{{v.model_label}="{model_id}"}}'
+    now = time.time()
+    window = [r for (t, r) in list(e.completions) if t >= now - 3600]
+    lines = [
+        f"# TYPE {v.num_requests_running} gauge",
+        f"{v.num_requests_running}{label} {e.num_running}",
+        f"# TYPE {v.request_success_total} counter",
+        f"{v.request_success_total}{label} {len(e.completions)}",
+        f"# TYPE {v.prompt_tokens_sum} counter",
+        f"{v.prompt_tokens_sum}{label} {sum(r.in_tokens for r in window)}",
+        f"{v.prompt_tokens_count}{label} {len(window)}",
+        f"# TYPE {v.generation_tokens_sum} counter",
+        f"{v.generation_tokens_sum}{label} {sum(r.out_tokens for r in window)}",
+        f"{v.generation_tokens_count}{label} {len(window)}",
+        f"# TYPE {v.ttft_seconds_sum} counter",
+        f"{v.ttft_seconds_sum}{label} {sum(r.ttft_ms for r in window) / 1000.0}",
+        f"{v.ttft_seconds_count}{label} {len(window)}",
+        f"# TYPE {v.tpot_seconds_sum} counter",
+        f"{v.tpot_seconds_sum}{label} "
+        f"{sum((r.latency_ms - r.ttft_ms) / max(r.out_tokens - 1, 1) for r in window) / 1000.0}",
+        f"{v.tpot_seconds_count}{label} {len(window)}",
+    ]
+    return "\n".join(lines) + "\n"
 
 
 def main() -> None:

@@ -1,0 +1,271 @@
+"""Real transformer compute for on-chip profiling: Llama-3.1-8B dimensions.
+
+The reference fits its linear performance profiles (alpha/beta/gamma/delta)
+from guidellm measurements against a live vLLM GPU server
+(/root/reference/docs/tutorials/parameter-estimation.md:127-266). The TPU
+build measures the same quantities from first principles: this module is a
+pure-JAX Llama-style decoder stack (GQA attention + SwiGLU MLP + RMSNorm +
+RoPE) at Llama-3.1-8B dimensions, jitted for the TPU, and timed by
+tools/profile_tpu.py over swept batch sizes / input lengths.
+
+Design notes (TPU-first):
+* A stack of L identical layers runs as one `lax.scan` over stacked
+  parameters — one compiled layer body, no Python-level unrolling, so
+  profiling depth L is a cheap runtime knob and compile time stays flat.
+* Decode steps are timed inside a `lax.fori_loop` of N steps in a single
+  jitted call, so per-step dispatch overhead (which a real serving engine
+  overlaps away) does not pollute the inter-token-latency measurement.
+* Everything is bfloat16 (MXU native) with float32 RMSNorm/softmax
+  accumulation, static shapes, and a preallocated KV cache updated via
+  `lax.dynamic_update_slice` — the same structure a JetStream-style decode
+  loop compiles to.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaDims:
+    """Model dimensions. Defaults are Llama-3.1-8B."""
+
+    hidden: int = 4096
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    ffn: int = 14336
+    vocab: int = 128256
+    n_layers: int = 32  # full model; profiling runs a sub-stack
+    rope_theta: float = 500000.0
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    def layer_params_bytes(self, dtype_bytes: int = 2) -> int:
+        attn = self.hidden * (self.q_dim + 2 * self.kv_dim) + self.q_dim * self.hidden
+        mlp = 3 * self.hidden * self.ffn
+        return (attn + mlp + 2 * self.hidden) * dtype_bytes
+
+    def kv_bytes_per_token(self, n_layers: int | None = None, dtype_bytes: int = 2) -> int:
+        layers = self.n_layers if n_layers is None else n_layers
+        return layers * 2 * self.kv_dim * dtype_bytes
+
+
+def init_stack(
+    key: jax.Array, dims: LlamaDims, n_layers: int, weight_dtype: str = "bfloat16"
+) -> dict:
+    """Stacked parameters for `n_layers` identical decoder layers plus the
+    final norm and LM head. Leading axis of each layer tensor is the layer
+    index (scanned).
+
+    weight_dtype "int8" stores projection weights quantized (w8a16, the
+    standard TPU serving configuration): decode is weight-read-bound, so
+    halving weight bytes roughly halves the step time. The dequant cast
+    fuses into the matmul read; norms stay bfloat16.
+    """
+    ks = jax.random.split(key, 8)
+    h, q, kv, f = dims.hidden, dims.q_dim, dims.kv_dim, dims.ffn
+    scale = 0.02
+    bf = jnp.bfloat16
+
+    def w(k, shape):
+        full = jax.random.normal(k, shape, dtype=jnp.float32) * scale
+        if weight_dtype == "int8":
+            return jnp.clip(jnp.round(full / scale * 63.0), -127, 127).astype(jnp.int8)
+        return full.astype(bf)
+
+    layers = {
+        "wq": w(ks[0], (n_layers, h, q)),
+        "wk": w(ks[1], (n_layers, h, kv)),
+        "wv": w(ks[2], (n_layers, h, kv)),
+        "wo": w(ks[3], (n_layers, q, h)),
+        "w_gate": w(ks[4], (n_layers, h, f)),
+        "w_up": w(ks[5], (n_layers, h, f)),
+        "w_down": w(ks[6], (n_layers, f, h)),
+        "norm_attn": jnp.ones((n_layers, h), dtype=bf),
+        "norm_mlp": jnp.ones((n_layers, h), dtype=bf),
+    }
+    return {
+        "layers": layers,
+        "norm_out": jnp.ones((h,), dtype=bf),
+        "lm_head": w(ks[7], (h, dims.vocab)),
+    }
+
+
+def _mm(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Matmul with on-the-fly dequant for int8-stored weights (w8a16):
+    the convert fuses into the weight read, so traffic is the int8 bytes."""
+    if w.dtype == jnp.int8:
+        w = w.astype(x.dtype)
+    return x @ w
+
+
+def _rmsnorm(x: jax.Array, g: jax.Array, eps: float = 1e-5) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * r).astype(x.dtype) * g
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: (..., seq, heads, head_dim); positions: (..., seq)."""
+    head_dim = x.shape[-1]
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # (..., seq, half)
+    cos = jnp.cos(angles)[..., :, None, :]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [(xf1 * cos - xf2 * sin).astype(x.dtype), (xf2 * cos + xf1 * sin).astype(x.dtype)],
+        axis=-1,
+    )
+
+
+def _gqa_attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array, dims: LlamaDims) -> jax.Array:
+    """q: (B, Tq, n_heads, hd); k,v: (B, n_kv_heads, Tk, hd) — head-major so
+    the per-step cache reads are contiguous (no transpose materialized);
+    mask: (B, Tq, Tk) additive. Returns (B, Tq, n_heads*hd)."""
+    b, tq = q.shape[0], q.shape[1]
+    groups = dims.n_heads // dims.n_kv_heads
+    qg = q.reshape(b, tq, dims.n_kv_heads, groups, dims.head_dim)
+    logits = jnp.einsum("bqhgd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    logits = logits * (dims.head_dim ** -0.5) + mask[:, None, None, :, :]
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bhkd->bqhgd", probs, v, preferred_element_type=jnp.float32)
+    return out.astype(q.dtype).reshape(b, tq, dims.q_dim)
+
+
+def _layer(x, layer_p, kv_cache, positions, mask, dims: LlamaDims):
+    """One decoder layer over (B, T, H) with KV cache write at `positions`.
+
+    kv_cache: (k, v) pair of (B, n_kv_heads, S_max, hd) buffers for this
+    layer, or None (prefill without cache retention). Head-major cache +
+    separate k/v carries keep the hot decode path free of transposes and
+    stacked copies. Returns (out, new_cache)."""
+    h = _rmsnorm(x, layer_p["norm_attn"])
+    b, t = x.shape[0], x.shape[1]
+    q = (_mm(h, layer_p["wq"])).reshape(b, t, dims.n_heads, dims.head_dim)
+    k = (_mm(h, layer_p["wk"])).reshape(b, t, dims.n_kv_heads, dims.head_dim)
+    v = (_mm(h, layer_p["wv"])).reshape(b, t, dims.n_kv_heads, dims.head_dim)
+    q = _rope(q, positions, dims.rope_theta)
+    k = _rope(k, positions, dims.rope_theta)
+    k = k.transpose(0, 2, 1, 3)  # (B, kvh, T, hd)
+    v = v.transpose(0, 2, 1, 3)
+
+    if kv_cache is not None:
+        start = positions[0, 0]
+        k_all = lax.dynamic_update_slice(kv_cache[0], k, (0, 0, start, 0))
+        v_all = lax.dynamic_update_slice(kv_cache[1], v, (0, 0, start, 0))
+        kv_cache = (k_all, v_all)
+    else:
+        k_all, v_all = k, v
+
+    attn = _gqa_attend(q, k_all, v_all, mask, dims)
+    x = x + _mm(attn, layer_p["wo"])
+    h = _rmsnorm(x, layer_p["norm_mlp"])
+    gated = jax.nn.silu((_mm(h, layer_p["w_gate"])).astype(jnp.float32)).astype(h.dtype)
+    x = x + _mm(gated * _mm(h, layer_p["w_up"]), layer_p["w_down"])
+    return x, kv_cache
+
+
+def make_prefill_repeat_fn(dims: LlamaDims, n_layers: int, reps: int):
+    """Jittable repeated prefill for profiling on high-RTT device tunnels:
+    runs the causal forward `reps` times inside one compiled call, each
+    iteration's input perturbed by the previous iteration's output so XLA
+    cannot hoist or CSE the loop body. Returns a scalar (forces full
+    execution when fetched to host). Time/call divided by `reps` = one
+    prefill's wall-clock."""
+
+    def prefill_body(params, x):
+        b, t = x.shape[0], x.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+        causal = jnp.where(
+            jnp.arange(t)[:, None] >= jnp.arange(t)[None, :], 0.0, -jnp.inf
+        ).astype(jnp.float32)
+        mask = jnp.broadcast_to(causal, (b, t, t))
+
+        def body(carry, layer_p):
+            y, _ = _layer(carry, layer_p, None, positions, mask, dims)
+            return y, None
+
+        y, _ = lax.scan(body, x, params["layers"])
+        y = _rmsnorm(y, params["norm_out"])
+        logits = _mm(y[:, -1, :], params["lm_head"])
+        return jnp.sum(logits.astype(jnp.float32))
+
+    def repeated(params, x):
+        def body(i, acc):
+            # data dependence across iterations defeats loop-invariant hoisting
+            s = prefill_body(params, x * (1.0 + acc * 1e-30).astype(x.dtype))
+            return acc + s * 1e-30
+
+        return lax.fori_loop(0, reps, body, jnp.float32(0.0))
+
+    return jax.jit(repeated)
+
+
+def make_decode_fn(dims: LlamaDims, n_layers: int, n_steps: int):
+    """Jittable multi-step greedy-shape decode: runs `n_steps` single-token
+    steps over the layer stack inside one compiled program.
+
+    (params, x0 (B,1,H), caches = flat tuple (k_0, v_0, ..., k_{L-1},
+    v_{L-1}) each (B,kvh,S_max,hd), start_pos) -> (scalar, x_final, caches).
+    Timing this and dividing by n_steps gives the inter-token latency
+    without per-call dispatch overhead.
+    """
+
+    def one_step(params, x, caches, pos):
+        """caches: flat tuple (k_0, v_0, k_1, v_1, ...) of per-layer
+        (B, kv_heads, S_max, hd) buffers. Layers are Python-unrolled and the
+        caches kept as individual while-loop carries: a lax.scan over layers
+        with the cache as xs/ys was measured to defeat XLA's in-place buffer
+        aliasing (~9x the ideal KV traffic per step on v5e)."""
+        b = x.shape[0]
+        s_max = caches[0].shape[2]
+        positions = jnp.broadcast_to(pos, (b, 1))
+        # attend to cache slots [0, pos]; future slots masked
+        valid = jnp.arange(s_max)[None, None, :] <= pos
+        mask = jnp.where(valid, 0.0, -jnp.inf).astype(jnp.float32)
+        mask = jnp.broadcast_to(mask, (b, 1, s_max))
+
+        new_caches = []
+        for li in range(n_layers):
+            layer_p = jax.tree.map(lambda t: t[li], params["layers"])
+            x, (k_c, v_c) = _layer(
+                x, layer_p, (caches[2 * li], caches[2 * li + 1]), positions, mask, dims
+            )
+            new_caches.extend([k_c, v_c])
+        caches = tuple(new_caches)
+        x = _rmsnorm(x, params["norm_out"])
+        logits = _mm(x[:, -1, :], params["lm_head"])
+        # feed a deterministic next embedding derived from logits; a real
+        # engine samples over the full vocab, so the caller must consume a
+        # reduction of ALL logits or XLA slices the head matmul down to the
+        # first `hidden` columns (observed: 40% of decode traffic DCE'd)
+        nxt = jnp.tanh(logits[:, : dims.hidden]).astype(jnp.bfloat16)[:, None, :]
+        return nxt, caches, jnp.sum(logits.astype(jnp.float32))
+
+    def decode(params, x, caches, start_pos):
+        def body(i, carry):
+            x, caches, acc = carry
+            x, caches, s = one_step(params, x, caches, start_pos + i)
+            return (x, caches, acc + s)
+
+        x, caches, acc = lax.fori_loop(0, n_steps, body, (x, caches, jnp.float32(0.0)))
+        # scalar the profiler can fetch to host to force execution without
+        # pulling the KV cache over a (possibly remote) transport; depends
+        # on every step's full logits
+        return acc + jnp.sum(x.astype(jnp.float32)), x, caches
+
+    return jax.jit(decode)

@@ -24,10 +24,10 @@ from inferno_tpu.controller.crd import (
 )
 from inferno_tpu.emulator import (
     EmulatedEngine,
-    EmulatorProm,
     EmulatorServer,
     EngineProfile,
     LoadGenerator,
+    MiniProm,
     RateSpec,
 )
 
@@ -143,7 +143,11 @@ def test_e2e_scale_out_then_in():
     full decision loop."""
     engine = EmulatedEngine(FAST)
     engine.start()
-    prom = EmulatorProm({MODEL: [engine]})
+    # in-process MiniProm: engines' exposition scraped on a thread, queried
+    # through the same PromQL-shape evaluator the sockets e2e uses
+    prom_srv = MiniProm.for_engines({MODEL: [engine]}, labels={"namespace": NS})
+    prom_srv.start()
+    prom = prom_srv.client()
     cluster = _cluster_for_emulator()
     rec = Reconciler(
         kube=cluster, prom=prom,
@@ -172,14 +176,20 @@ def test_e2e_scale_out_then_in():
         deploy = cluster.get_deployment(NS, "emulated-llama")
         assert deploy["spec"]["replicas"] == desired_loaded
 
-        # idle: clear telemetry windows -> next cycle sees zero load
+        # idle: clear telemetry windows (engine counters AND the scrape
+        # history holding the old counter increases) -> next cycle sees
+        # zero load
         engine.completions.clear()
         engine.arrivals.clear()
+        prom_srv.history.clear()
+        prom_srv.scrape_once()
+        prom_srv.scrape_once()
         report2 = rec.run_cycle()
         assert report2.errors == []
         va2 = cluster.get_variant_autoscaling(NS, "emulated-llama")
         assert va2.status.desired_optimized_alloc.num_replicas == 1
     finally:
+        prom_srv.stop()
         engine.stop()
 
 

@@ -1,0 +1,140 @@
+"""Profile synthesis pipeline: raw per-depth measurements -> full-model
+extrapolation -> linear profile fit -> committed profile JSON -> ModelPerfSpec.
+
+Mirrors the reference's parameter-estimation methodology tests
+(/root/reference/docs/tutorials/parameter-estimation.md:241-266) but for the
+measured-TPU pipeline in inferno_tpu.models.profiles. Uses synthetic raw
+data with known ground truth; the committed profiles/*.json (written by
+tools/profile_tpu.py on the real chip) are validated for shape and
+loadability when present.
+"""
+
+import json
+import math
+from pathlib import Path
+
+import pytest
+
+from inferno_tpu.config.types import ModelPerfSpec
+from inferno_tpu.models.llama_block import LlamaDims
+from inferno_tpu.models.profiles import (
+    PROFILES_DIR,
+    build_profile_json,
+    derive_tensor_parallel,
+    fit_tpu_profile,
+    load_profile,
+    max_batch_from_memory,
+    synthesize_full_model,
+)
+
+# ground truth for synthetic raw data: per-layer decode cost m_d + c per
+# call; full model = c + 32*m
+TRUE_LAYER_MS = 0.6
+TRUE_HEAD_MS = 1.5
+TRUE_BETA_PER_LAYER = 0.004
+TRUE_PREFILL_PER_LAYER_PER_TOK = 0.003
+
+
+def fake_raw():
+    decode, prefill = [], []
+    for n_layers in (2, 4, 8):
+        for b in (1, 8, 32, 64):
+            step = TRUE_HEAD_MS + n_layers * (TRUE_LAYER_MS + TRUE_BETA_PER_LAYER * b)
+            decode.append(
+                {"n_layers": n_layers, "batch": b, "context": 1024, "step_ms": step}
+            )
+        for b in (1, 2):
+            for t in (128, 512, 2048):
+                ms = TRUE_HEAD_MS + n_layers * TRUE_PREFILL_PER_LAYER_PER_TOK * b * t
+                prefill.append(
+                    {"n_layers": n_layers, "batch": b, "in_tokens": t, "prefill_ms": ms}
+                )
+    return {
+        "meta": {
+            "model": "llama-3.1-8b",
+            "dims": {
+                "hidden": 4096, "n_heads": 32, "n_kv_heads": 8, "head_dim": 128,
+                "ffn": 14336, "vocab": 128256, "n_layers_full": 32,
+            },
+        },
+        "decode": decode,
+        "prefill": prefill,
+    }
+
+
+def test_layer_extrapolation_recovers_ground_truth():
+    decode, prefill, meta = synthesize_full_model(fake_raw(), n_layers_full=32)
+    assert meta["decode_layer_linearity_r2"] > 0.999
+    assert meta["prefill_layer_linearity_r2"] > 0.999
+    by_batch = {p["batch"]: p["step_ms"] for p in decode}
+    expected_b1 = TRUE_HEAD_MS + 32 * (TRUE_LAYER_MS + TRUE_BETA_PER_LAYER)
+    assert by_batch[1] == pytest.approx(expected_b1, rel=1e-6)
+
+
+def test_fit_recovers_linear_parms():
+    fitted, _ = fit_tpu_profile(fake_raw())
+    assert fitted.decode.alpha == pytest.approx(TRUE_HEAD_MS + 32 * TRUE_LAYER_MS, rel=1e-6)
+    assert fitted.decode.beta == pytest.approx(32 * TRUE_BETA_PER_LAYER, rel=1e-6)
+    assert fitted.prefill.delta == pytest.approx(32 * TRUE_PREFILL_PER_LAYER_PER_TOK, rel=1e-6)
+    assert fitted.decode_rmse < 1e-6
+
+
+def test_extrapolation_rejects_single_depth():
+    raw = fake_raw()
+    raw["decode"] = [s for s in raw["decode"] if s["n_layers"] == 4]
+    with pytest.raises(ValueError):
+        synthesize_full_model(raw)
+
+
+def test_max_batch_from_memory():
+    dims = LlamaDims()
+    # int8 weights on one 16 GB chip leave a few GB of KV at 1280-token ctx
+    mb1 = max_batch_from_memory(dims, 16.0, 1280, weight_bytes_per_param=1.0)
+    assert 8 <= mb1 <= 64
+    # bf16 weights do NOT fit one chip at all
+    assert max_batch_from_memory(dims, 16.0, 1280, weight_bytes_per_param=2.0) == 0
+    # 4 chips, bf16: plenty
+    mb4 = max_batch_from_memory(dims, 16.0, 1280, weight_bytes_per_param=2.0, n_chips=4)
+    assert mb4 > 2 * mb1
+
+
+def test_derive_tensor_parallel_scales_and_adds_ici():
+    fitted, _ = fit_tpu_profile(fake_raw())
+    tp4 = derive_tensor_parallel(fitted, 4)
+    # per-chip traffic divides by 4, ICI cost is additive
+    assert tp4.decode.alpha > fitted.decode.alpha / 4
+    assert tp4.decode.alpha < fitted.decode.alpha / 2
+    assert tp4.decode.beta < fitted.decode.beta  # net win per batch unit too
+
+
+def test_build_profile_json_roundtrips_to_perf_spec(tmp_path):
+    doc = build_profile_json(fake_raw(), "v5e-1", n_chips=1)
+    assert doc["derived"] is False
+    p = tmp_path / "p.json"
+    p.write_text(json.dumps(doc))
+    spec = load_profile(p)
+    assert isinstance(spec, ModelPerfSpec)
+    assert spec.acc == "v5e-1"
+    assert spec.decode_parms.alpha == doc["decodeParms"]["alpha"]
+    assert spec.max_batch_size == doc["maxBatchSize"] > 0
+
+
+def test_derived_profile_marked():
+    doc = build_profile_json(fake_raw(), "v5e-4", n_chips=4)
+    assert doc["derived"] is True
+    assert doc["assumptions"]["n_chips"] == 4
+    # bf16 weights across 4 chips
+    assert doc["assumptions"]["weight_bytes_per_param"] == 2.0
+
+
+@pytest.mark.parametrize("path", sorted(PROFILES_DIR.glob("*.json")) or [None])
+def test_committed_profiles_load(path):
+    if path is None:
+        pytest.skip("no committed profiles yet")
+    spec = load_profile(path)
+    assert spec.decode_parms.alpha > 0
+    assert spec.max_batch_size > 0
+    doc = json.loads(Path(path).read_text())
+    assert doc["fit"]["decode_layer_linearity_r2"] > 0.99
+    # committed measured profiles must be marked measured
+    assert isinstance(doc["derived"], bool)

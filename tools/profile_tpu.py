@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Profile real Llama-3.1-8B transformer compute on the local TPU chip.
+
+Produces the raw measurements behind the committed performance profiles
+(profiles/*.json): decode step time per layer-stack depth (-> ITL = alpha +
+beta*batch) and prefill time (-> TTFT = gamma + delta*in_tokens*batch),
+measured at Llama-3.1-8B dimensions on whatever `jax.devices()[0]` is.
+
+Methodology (mirrors the reference's guidellm procedure,
+/root/reference/docs/tutorials/parameter-estimation.md:127-266, but measures
+the compiled model directly instead of a serving endpoint):
+
+1. Build an L-layer Llama-8B-dim decoder stack (inferno_tpu.models.
+   llama_block) for L in --layer-depths. A full 32-layer bf16 8B does not
+   fit in one v5e chip's 16 GB HBM, so we measure sub-stacks and verify
+   time is linear in L (it is a scan of identical layers); the full-model
+   profile is synthesized from the per-depth least-squares fit in
+   inferno_tpu.models.profiles.
+2. Decode: N single-token steps chained inside one jitted fori_loop, swept
+   over batch sizes at a fixed KV context.
+3. Prefill: the causal forward repeated R times inside one jitted loop with
+   an inter-iteration data dependence (no hoisting), swept over
+   (batch, in_tokens).
+
+Timing discipline: this environment reaches the TPU through a network
+tunnel where `block_until_ready` does not reliably block, so every timed
+call fetches a scalar to host, and the measured tunnel round-trip (median
+of a trivial jitted call + fetch) is subtracted before dividing by the
+inner step/repeat count. Inner counts are sized so device compute dominates
+the round-trip.
+
+Writes one JSON file with every sample plus environment metadata. Run:
+    python tools/profile_tpu.py --out profiles/raw/llama-3.1-8b_tpu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+from inferno_tpu.models.llama_block import (
+    LlamaDims,
+    init_stack,
+    make_decode_fn,
+    make_prefill_repeat_fn,
+)
+
+DECODE_BATCHES = [1, 2, 4, 8, 16, 24, 32, 48, 64, 96, 128]
+PREFILL_BATCHES = [1, 2, 4]
+PREFILL_TOKENS = [128, 256, 512, 1024, 2048]
+LAYER_DEPTHS = [2, 4, 8]
+
+
+def measure_rtt(iters: int = 30) -> float:
+    """Median msec of a trivial jitted call + scalar fetch (tunnel RTT +
+    dispatch floor)."""
+    f = jax.jit(lambda x: x * 2.0)
+    x = jnp.float32(1.0)
+    float(f(x))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(f(x))
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return statistics.median(ts)
+
+
+def _timed_ms(call, iters: int, rtt_ms: float, inner: int) -> float:
+    """Median over `iters` of (wall - rtt)/inner, msec. `call` must return
+    something whose float() forces device execution."""
+    float(call())  # compile + warm
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(call())
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return max(statistics.median(ts) - rtt_ms, 0.0) / inner
+
+
+def profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out):
+    params = init_stack(jax.random.PRNGKey(n_layers), dims, n_layers, args.weight_dtype)
+    jax.block_until_ready(params)
+
+    steps = args.decode_steps
+    decode = make_decode_fn(dims, n_layers, steps)
+    for b in args.decode_batches:
+        s_max = args.context + steps
+        cache_gb = (
+            n_layers * 2 * b * s_max * dims.kv_dim * 2 / 2**30
+        )
+        if cache_gb > args.max_cache_gb:
+            print(f"decode  L={n_layers:2d} B={b:3d}: skipped (KV cache {cache_gb:.1f} GiB)")
+            continue
+        caches = tuple(
+            jnp.zeros((b, dims.n_kv_heads, s_max, dims.head_dim), dtype=jnp.bfloat16)
+            for _ in range(2 * n_layers)
+        )
+        x = jnp.zeros((b, 1, dims.hidden), dtype=jnp.bfloat16)
+        start = jnp.int32(args.context)
+        ms = _timed_ms(
+            lambda: decode(params, x, caches, start)[0],
+            args.iters, rtt_ms, steps,
+        )
+        decode_out.append(
+            {"n_layers": n_layers, "batch": b, "context": args.context, "step_ms": ms}
+        )
+        print(f"decode  L={n_layers:2d} B={b:3d} ctx={args.context}: {ms:8.3f} ms/step", flush=True)
+        del caches
+
+    for b in args.prefill_batches:
+        for t in args.prefill_tokens:
+            # size the repeat count so device time ~ args.target_ms, one
+            # compile per (shape, reps) with reps quantized to powers of 4
+            est = 0.35 * n_layers * b * t / 512  # rough ms estimate to pick reps
+            reps = 1
+            while reps < 64 and est * reps < args.target_ms:
+                reps *= 4
+            prefill = make_prefill_repeat_fn(dims, n_layers, reps)
+            x = jnp.ones((b, t, dims.hidden), dtype=jnp.bfloat16) * 0.01
+            ms = _timed_ms(lambda: prefill(params, x), args.iters, rtt_ms, reps)
+            prefill_out.append(
+                {"n_layers": n_layers, "batch": b, "in_tokens": t, "reps": reps, "prefill_ms": ms}
+            )
+            print(f"prefill L={n_layers:2d} B={b:3d} T={t:5d} (x{reps}): {ms:8.3f} ms", flush=True)
+    del params
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="profiles/raw/llama-3.1-8b_tpu.json")
+    ap.add_argument("--iters", type=int, default=7)
+    ap.add_argument("--weight-dtype", choices=["bfloat16", "int8"], default="bfloat16")
+    ap.add_argument("--decode-steps", type=int, default=64)
+    ap.add_argument("--context", type=int, default=1024)
+    ap.add_argument("--target-ms", type=float, default=250.0)
+    ap.add_argument("--max-cache-gb", type=float, default=6.0)
+    ap.add_argument("--layer-depths", type=int, nargs="+", default=LAYER_DEPTHS)
+    ap.add_argument("--decode-batches", type=int, nargs="+", default=DECODE_BATCHES)
+    ap.add_argument("--prefill-batches", type=int, nargs="+", default=PREFILL_BATCHES)
+    ap.add_argument("--prefill-tokens", type=int, nargs="+", default=PREFILL_TOKENS)
+    args = ap.parse_args()
+
+    dims = LlamaDims()
+    dev = jax.devices()[0]
+    rtt_ms = measure_rtt()
+    meta = {
+        "model": "llama-3.1-8b",
+        "dims": {
+            "hidden": dims.hidden, "n_heads": dims.n_heads,
+            "n_kv_heads": dims.n_kv_heads, "head_dim": dims.head_dim,
+            "ffn": dims.ffn, "vocab": dims.vocab, "n_layers_full": dims.n_layers,
+        },
+        "device": {"kind": dev.device_kind, "platform": dev.platform},
+        "jax_version": jax.__version__,
+        "dtype": "bfloat16",
+        "weight_dtype": args.weight_dtype,
+        "decode_context": args.context,
+        "decode_steps_per_call": args.decode_steps,
+        "iters": args.iters,
+        "tunnel_rtt_ms": round(rtt_ms, 3),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(f"profiling on {dev.device_kind} ({dev.platform}); tunnel RTT {rtt_ms:.1f} ms", flush=True)
+
+    t0 = time.time()
+    decode_out, prefill_out = [], []
+    for n_layers in args.layer_depths:
+        profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out)
+    meta["wall_clock_s"] = round(time.time() - t0, 1)
+
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps({"meta": meta, "decode": decode_out, "prefill": prefill_out}, indent=1))
+    print(f"wrote {out} ({len(decode_out)} decode + {len(prefill_out)} prefill samples, "
+          f"{meta['wall_clock_s']}s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
