@@ -56,6 +56,7 @@ from inferno_tpu.analyzer.queue import (
     size_with_targets,
     solve_birth_death,
 )
+from inferno_tpu.config.defaults import SLO_MARGIN
 from inferno_tpu.config.types import DecodeParms, DisaggSpec, PrefillParms
 
 
@@ -128,6 +129,11 @@ class DisaggAnalyzer:
         )
 
     def _ttft_at(self, lam_unit: float) -> float:
+        return self._tail_ttft_at(lam_unit, 1.0)
+
+    def _tail_ttft_at(self, lam_unit: float, margin: float = SLO_MARGIN) -> float:
+        """TTFT with the prefill-stage wait scaled to its SLO percentile
+        (margin = 1.0 gives the mean; see queue.size_with_targets)."""
         stats = self._solve_prefill(lam_unit)
         conc = _effective_concurrency(
             stats.avg_serv_time,
@@ -135,7 +141,7 @@ class DisaggAnalyzer:
             self.prefill.delta * self.request.avg_in_tokens,
             self.prefill_max_batch,
         )
-        return stats.avg_wait_time + prefill_time(
+        return margin * stats.avg_wait_time + prefill_time(
             self.prefill, self.request.avg_in_tokens, conc
         )
 
@@ -211,10 +217,13 @@ class DisaggAnalyzer:
             rho=rho,
         )
 
-    def size(self, targets: TargetPerf) -> tuple[TargetRate, AnalysisMetrics, TargetPerf]:
+    def size(
+        self, targets: TargetPerf, ttft_tail_margin: float = SLO_MARGIN
+    ) -> tuple[TargetRate, AnalysisMetrics, TargetPerf]:
         """Max unit request rates meeting each SLO target; shares the
-        sizing driver with `QueueAnalyzer.size`."""
-        return size_with_targets(self, targets)
+        sizing driver (and its percentile TTFT semantics) with
+        `QueueAnalyzer.size`."""
+        return size_with_targets(self, targets, ttft_tail_margin)
 
 
 def build_disagg_analyzer(

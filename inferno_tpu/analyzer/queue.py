@@ -41,7 +41,7 @@ import dataclasses
 
 import numpy as np
 
-from inferno_tpu.config.defaults import STABILITY_SAFETY_FRACTION
+from inferno_tpu.config.defaults import SLO_MARGIN, STABILITY_SAFETY_FRACTION
 from inferno_tpu.config.types import DecodeParms, PrefillParms
 from inferno_tpu.analyzer.sizing import bisect_monotone
 
@@ -229,19 +229,29 @@ def effective_concurrency(
 
 
 def size_with_targets(
-    analyzer, targets: TargetPerf
+    analyzer, targets: TargetPerf, ttft_tail_margin: float = SLO_MARGIN
 ) -> tuple[TargetRate, AnalysisMetrics, TargetPerf]:
     """Shared sizing driver for any analyzer exposing lambda_min/lambda_max,
-    _ttft_at, _itl_at, analyze, and a request (QueueAnalyzer and
+    _tail_ttft_at, _itl_at, analyze, and a request (QueueAnalyzer and
     DisaggAnalyzer): bisect the max rate for each active target, cap TPS by
     the stability headroom, evaluate at the binding minimum
-    (reference: pkg/analyzer/queueanalyzer.go:185-255)."""
+    (reference: pkg/analyzer/queueanalyzer.go:185-255).
+
+    TTFT targets are interpreted at SLO_PERCENTILE: the bisection bounds
+    `ttft_tail_margin * wait + prefill`, so the percentile (not just the
+    mean) of TTFT meets the target under the exponential-wait assumption
+    the reference documents but never applies (pkg/core/allocation.go:117).
+    Pass ttft_tail_margin=1.0 for reference-exact mean semantics, or
+    slo_margin_for(0.99) for a p99 interpretation."""
     targets.validate()
     lam_min, lam_max = analyzer.lambda_min, analyzer.lambda_max
 
     lam_ttft = lam_max
     if targets.target_ttft > 0:
-        res = bisect_monotone(lam_min, lam_max, targets.target_ttft, analyzer._ttft_at)
+        res = bisect_monotone(
+            lam_min, lam_max, targets.target_ttft,
+            lambda lam: analyzer._tail_ttft_at(lam, ttft_tail_margin),
+        )
         if res.indicator < 0:
             raise AnalyzerError(
                 f"TTFT target {targets.target_ttft} ms unachievable: "
@@ -307,11 +317,18 @@ class QueueAnalyzer:
         return solve_birth_death(lam, self.serv_rates, self.occupancy_cap)
 
     def _ttft_at(self, lam: float) -> float:
+        return self._tail_ttft_at(lam, 1.0)
+
+    def _tail_ttft_at(self, lam: float, margin: float = SLO_MARGIN) -> float:
+        """TTFT with the queueing-wait component scaled to its SLO
+        percentile (margin = 1.0 gives the mean)."""
         stats = self._solve(lam)
         conc = effective_concurrency(
             stats.avg_serv_time, self.decode, self.prefill, self.request, self.max_batch
         )
-        return stats.avg_wait_time + prefill_time(self.prefill, self.request.avg_in_tokens, conc)
+        return margin * stats.avg_wait_time + prefill_time(
+            self.prefill, self.request.avg_in_tokens, conc
+        )
 
     def _itl_at(self, lam: float) -> float:
         stats = self._solve(lam)
@@ -346,16 +363,18 @@ class QueueAnalyzer:
         )
 
     def size(
-        self, targets: TargetPerf
+        self, targets: TargetPerf, ttft_tail_margin: float = SLO_MARGIN
     ) -> tuple[TargetRate, AnalysisMetrics, TargetPerf]:
         """Max request rates meeting each SLO target, plus metrics and
         achieved values at the binding (minimum) rate
-        (reference: pkg/analyzer/queueanalyzer.go:185-255).
+        (reference: pkg/analyzer/queueanalyzer.go:185-255). TTFT targets
+        bind at SLO_PERCENTILE via `ttft_tail_margin` (see
+        size_with_targets).
 
         Raises AnalyzerError when a target is unachievable even at the
         lowest stable rate.
         """
-        return size_with_targets(self, targets)
+        return size_with_targets(self, targets, ttft_tail_margin)
 
 
 def build_analyzer(

@@ -7,14 +7,29 @@ domain where noted.
 """
 
 import enum
+import math
 
-# Percentile assumed when SLO targets are interpreted against average-value
-# queueing statistics (reference: pkg/config/defaults.go:12).
+# Percentile at which latency SLO targets are interpreted
+# (reference: pkg/config/defaults.go:12).
 SLO_PERCENTILE = 0.95
 
-# Multiplier applied to average statistics to approximate the SLO percentile
-# under an exponential-tail assumption (reference: pkg/config/defaults.go:15).
-SLO_MARGIN = 3.0
+# Multiplier taking the *mean queueing wait* to its SLO_PERCENTILE quantile
+# under an exponential-tail assumption: P(W > m·E[W]) = e^-m for exponential
+# W, so m = -ln(1 - percentile). The reference defines the same constant and
+# leaves its application commented out (pkg/config/defaults.go:15,
+# pkg/core/allocation.go:117); here sizing actually applies it — TTFT
+# targets bound margin·wait + prefill, so the *percentile* TTFT meets the
+# SLO, not just the mean (prefill time at a given concurrency is
+# deterministic; the queueing wait carries the tail).
+SLO_MARGIN = -math.log(1.0 - SLO_PERCENTILE)
+
+
+def slo_margin_for(percentile: float) -> float:
+    """Mean-wait multiplier reaching `percentile` under an exponential tail
+    (e.g. 0.99 -> 4.6)."""
+    if not 0.0 < percentile < 1.0:
+        raise ValueError(f"percentile must be in (0,1), got {percentile}")
+    return -math.log(1.0 - percentile)
 
 # Maximum queue length as a multiple of the max batch size
 # (reference: pkg/config/defaults.go:18).

@@ -14,27 +14,33 @@ import dataclasses
 from inferno_tpu.controller.crd import VariantAutoscaling
 from inferno_tpu.controller.kube import KubeClient, KubeError
 from inferno_tpu.controller.metrics import MetricsEmitter
+from inferno_tpu.controller.workload import get_workload, scale_workload
 
 
 @dataclasses.dataclass
 class Actuator:
     kube: KubeClient
     emitter: MetricsEmitter
-    direct_scale: bool = False  # scale Deployments directly (no HPA present)
+    direct_scale: bool = False  # scale workloads directly (no HPA present)
 
     def current_replicas(self, va: VariantAutoscaling) -> int:
-        """Observed replicas from the owning Deployment (same name/ns)
-        (reference getCurrentDeploymentReplicas: actuator.go:29-48)."""
-        deploy = self.kube.get_deployment(va.namespace, va.name)
-        status = deploy.get("status", {}) or {}
-        if "readyReplicas" in status:
-            return int(status.get("readyReplicas") or 0)
-        return int(deploy.get("spec", {}).get("replicas", 0) or 0)
+        """Observed replicas from the owning workload (same name/ns),
+        counted in replica units — pods for a Deployment, whole pod
+        groups for a multi-host LeaderWorkerSet
+        (reference getCurrentDeploymentReplicas: actuator.go:29-48, minus
+        its 1-replica=1-pod assumption)."""
+        return self._observed(get_workload(self.kube, va.namespace, va.name))
+
+    @staticmethod
+    def _observed(wl) -> int:
+        ready = wl.ready_replicas
+        return ready if ready is not None else wl.replicas
 
     def emit_metrics(self, va: VariantAutoscaling) -> None:
         """(reference EmitMetrics: actuator.go:50-84); failures must not
         fail the reconcile cycle (actuator.go:69-74) — callers catch."""
-        current = self.current_replicas(va)
+        wl = get_workload(self.kube, va.namespace, va.name)
+        current = self._observed(wl)
         desired = va.status.desired_optimized_alloc.num_replicas
         accelerator = va.status.desired_optimized_alloc.accelerator
         self.emitter.emit_replica_metrics(
@@ -46,6 +52,6 @@ class Actuator:
         )
         if self.direct_scale and desired != current:
             try:
-                self.kube.scale_deployment(va.namespace, va.name, desired)
+                scale_workload(self.kube, wl, desired)
             except KubeError:
                 pass  # next cycle retries; metrics already emitted

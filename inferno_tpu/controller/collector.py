@@ -151,16 +151,22 @@ def collect_current_alloc(
     prom: PromClient,
     engine: EngineMetrics,
     va: VariantAutoscaling,
-    deployment: dict,
+    workload,
     accelerator_cost: float,
 ) -> CurrentAlloc:
     """Build the observed CurrentAlloc from five Prometheus queries plus
-    Deployment state (reference AddMetricsToOptStatus: collector.go:158-278).
+    workload state (reference AddMetricsToOptStatus: collector.go:158-278).
+
+    `workload` is a controller.workload.Workload: replicas are counted in
+    REPLICA units — pods for a Deployment, whole pod groups for a
+    multi-host LeaderWorkerSet — so a v5e-16 slice spanning 4 hosts reads
+    as 1 replica, not 4 pods (replaces the reference's 1-replica=1-pod
+    assumption, collector.go:243-244).
 
     Raises PromError on query failure (callers skip the variant for this
     cycle, like the reference).
     """
-    ns = deployment.get("metadata", {}).get("namespace", va.namespace)
+    ns = workload.namespace or va.namespace
     model = va.spec.model_id
     sel = _selector(engine, model, ns)
 
@@ -180,7 +186,7 @@ def collect_current_alloc(
         prom.query(_rate_ratio(engine, engine.tpot_seconds_sum, engine.tpot_seconds_count, model, ns))
     ) * 1000.0
 
-    replicas = int(deployment.get("spec", {}).get("replicas", 0) or 0)
+    replicas = workload.replicas
     accelerator = va.labels.get(ACCELERATOR_LABEL, "")
     return CurrentAlloc(
         accelerator=accelerator,

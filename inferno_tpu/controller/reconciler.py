@@ -53,6 +53,7 @@ from inferno_tpu.controller.crd import (
 from inferno_tpu.controller.engines import EngineMetrics, engine_for
 from inferno_tpu.controller.inventory import collect_tpu_inventory
 from inferno_tpu.controller.kube import KubeClient, KubeError, NotFound
+from inferno_tpu.controller.workload import get_workload
 from inferno_tpu.controller.promclient import PromClient, PromError
 from inferno_tpu.core import System
 from inferno_tpu.solver import Optimizer
@@ -254,21 +255,27 @@ class Reconciler:
                 return sc.name, t
         return None
 
-    def _set_owner_reference(self, va: VariantAutoscaling, deployment: dict) -> None:
-        """Deployment owns the VA so deleting it GCs the VA
-        (reference: controller.go:276-293)."""
-        uid = deployment.get("metadata", {}).get("uid", "")
+    def _set_owner_reference(self, va: VariantAutoscaling, workload) -> None:
+        """The workload (Deployment or LeaderWorkerSet) owns the VA so
+        deleting it GCs the VA (reference: controller.go:276-293)."""
         ref = {
-            "apiVersion": "apps/v1",
-            "kind": "Deployment",
-            "name": deployment.get("metadata", {}).get("name", va.name),
-            "uid": uid,
+            "apiVersion": workload.api_version,
+            "kind": workload.kind,
+            "name": workload.name or va.name,
+            "uid": workload.uid,
             "controller": True,
             "blockOwnerDeletion": False,
         }
         for existing in va.owner_references:
-            if existing.get("kind") == "Deployment" and existing.get("name") == ref["name"]:
+            if existing.get("kind") == ref["kind"] and existing.get("name") == ref["name"]:
                 return
+        # only one controller ref may exist: a workload-kind change
+        # (Deployment -> LWS of the same name) replaces the stale ref
+        # instead of appending a second controller:True entry, which a real
+        # API server rejects
+        va.owner_references[:] = [
+            r for r in va.owner_references if not r.get("controller")
+        ]
         va.owner_references.append(ref)
         if not self.gate():
             return  # deposed mid-cycle: leave the patch to the new leader
@@ -319,11 +326,11 @@ class Reconciler:
             return False
 
         try:
-            deployment = self.kube.get_deployment(va.namespace, va.name)
+            wl = get_workload(self.kube, va.namespace, va.name)
         except KubeError as e:
-            report.errors.append(f"{va.full_name}: deployment: {e}")
+            report.errors.append(f"{va.full_name}: workload: {e}")
             return False
-        self._set_owner_reference(va, deployment)
+        self._set_owner_reference(va, wl)
 
         validation = validate_metrics_availability(
             self.prom, engine, va.spec.model_id, va.namespace
@@ -351,7 +358,7 @@ class Reconciler:
         acc_name = va.labels.get("inference.optimization/acceleratorName", "")
         cost = accelerators[acc_name].cost_per_chip_hr if acc_name in accelerators else 0.0
         try:
-            current = collect_current_alloc(self.prom, engine, va, deployment, cost)
+            current = collect_current_alloc(self.prom, engine, va, wl, cost)
         except PromError as e:
             report.errors.append(f"{va.full_name}: collect: {e}")
             return False

@@ -179,7 +179,7 @@ def _layer(x, layer_p, kv_cache, positions, mask, dims: LlamaDims):
     return x, kv_cache
 
 
-def make_prefill_repeat_fn(dims: LlamaDims, n_layers: int, reps: int):
+def make_prefill_repeat_fn(dims: LlamaDims, reps: int):
     """Jittable repeated prefill for profiling on high-RTT device tunnels:
     runs the causal forward `reps` times inside one compiled call, each
     iteration's input perturbed by the previous iteration's output so XLA

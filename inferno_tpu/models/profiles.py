@@ -186,12 +186,13 @@ def build_profile_json(
 ) -> dict:
     """Full profile document for one (model, slice shape)."""
     dims_in = dict(raw["meta"]["dims"])
-    dims_in["n_layers"] = dims_in.pop("n_layers_full", 32)
+    n_layers_full = dims_in.pop("n_layers_full")
+    dims_in["n_layers"] = n_layers_full
     dims = LlamaDims(**dims_in)
-    fitted, synth_meta = fit_tpu_profile(raw, raw["meta"]["dims"]["n_layers_full"])
+    fitted, synth_meta = fit_tpu_profile(raw, n_layers_full)
     derived = n_chips > 1
     if derived:
-        fitted = derive_tensor_parallel(fitted, n_chips, n_layers=raw["meta"]["dims"]["n_layers_full"], hidden=dims.hidden)
+        fitted = derive_tensor_parallel(fitted, n_chips, n_layers=n_layers_full, hidden=dims.hidden)
         # multi-chip serving fits bf16 weights
         weight_bytes_per_param = 2.0
     max_batch = max_batch_from_memory(

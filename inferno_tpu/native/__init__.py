@@ -75,6 +75,7 @@ def _load() -> ctypes.CDLL | None:
                 _D, _D, _D,  # targets ttft itl tps
                 _D, _I, _D,  # total_rate min_replicas cost_per_replica
                 ctypes.c_int32,  # n_iters
+                ctypes.c_double,  # ttft_tail_margin
                 ctypes.c_int32,  # n_threads
                 _U8, _D, _D, _I, _D, _D, _D, _D,  # outputs
             ]
@@ -107,13 +108,19 @@ class NativeFleetResult(NamedTuple):
 
 
 def fleet_size_native(
-    params, n_iters: int = DEFAULT_BISECT_ITERS, n_threads: int = 0
+    params, n_iters: int = DEFAULT_BISECT_ITERS, n_threads: int = 0,
+    ttft_tail_margin: float | None = None,
 ) -> NativeFleetResult:
     """Size every lane of a FleetParams batch with the C++ solver.
 
     `params` is any structure with the FleetParams fields (numpy or jax
-    arrays). Semantics match ops.queueing.fleet_size; precision is f64.
+    arrays). Semantics match ops.queueing.fleet_size, including the
+    percentile TTFT interpretation (default SLO_MARGIN); precision is f64.
     """
+    if ttft_tail_margin is None:
+        from inferno_tpu.config.defaults import SLO_MARGIN
+
+        ttft_tail_margin = SLO_MARGIN
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native library unavailable: {_load_error}")
@@ -145,7 +152,7 @@ def fleet_size_native(
         i(params.max_batch), i(params.occupancy_cap),
         d(params.target_ttft), d(params.target_itl), d(params.target_tps),
         d(params.total_rate), i(params.min_replicas), d(params.cost_per_replica),
-        n_iters, n_threads,
+        n_iters, ttft_tail_margin, n_threads,
         out.feasible, out.lambda_star, out.rate_star, out.num_replicas,
         out.cost, out.itl, out.ttft, out.rho,
     )

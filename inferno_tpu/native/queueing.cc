@@ -127,13 +127,15 @@ double concurrency(const Lane& ln, double serv) {
   return std::clamp(numer / denom, 0.0, nmax);
 }
 
-void ttft_itl_at(double lam, const Lane& ln, const Grid& g, double* ttft,
-                 double* itl) {
+// wait_margin scales the queueing-wait component of TTFT to its SLO
+// percentile for sizing (queue.size_with_targets); 1.0 gives the mean.
+void ttft_itl_at(double lam, const Lane& ln, const Grid& g, double wait_margin,
+                 double* ttft, double* itl) {
   Stats s = solve_stats(lam, g);
   double conc = concurrency(ln, s.serv);
   double prefill =
       ln.in_tokens > 0.0 ? ln.gamma + ln.delta * ln.in_tokens * conc : 0.0;
-  *ttft = s.wait + prefill;
+  *ttft = wait_margin * s.wait + prefill;
   *itl = ln.alpha + ln.beta * conc;
 }
 
@@ -142,7 +144,8 @@ void ttft_itl_at(double lam, const Lane& ln, const Grid& g, double* ttft,
 // pkg/analyzer/utils.go:44-50).
 void bisect(const Lane& ln, const Grid& g, double lam_min, double lam_max,
             double target, double y_lo, double y_hi, bool use_itl,
-            int32_t n_iters, double* lam_out, bool* ok_out) {
+            double wait_margin, int32_t n_iters, double* lam_out,
+            bool* ok_out) {
   const bool feasible = target >= y_lo * (1.0 - kFeasSlack);
   if (target >= y_hi) {
     *lam_out = lam_max;
@@ -153,7 +156,7 @@ void bisect(const Lane& ln, const Grid& g, double lam_min, double lam_max,
   for (int32_t i = 0; i < n_iters; ++i) {
     const double mid = 0.5 * (lo + hi);
     double ttft, itl;
-    ttft_itl_at(mid, ln, g, &ttft, &itl);
+    ttft_itl_at(mid, ln, g, wait_margin, &ttft, &itl);
     const double y = use_itl ? itl : ttft;
     if (y > target)
       hi = mid;
@@ -164,7 +167,8 @@ void bisect(const Lane& ln, const Grid& g, double lam_min, double lam_max,
   *ok_out = feasible;
 }
 
-void size_lane(const Lane& ln, int32_t n_iters, uint8_t* feasible,
+void size_lane(const Lane& ln, int32_t n_iters, double ttft_tail_margin,
+               uint8_t* feasible,
                double* lambda_star, double* rate_star, int32_t* num_replicas,
                double* cost, double* itl_out, double* ttft_out, double* rho) {
   const Grid g = make_grid(ln);
@@ -172,17 +176,17 @@ void size_lane(const Lane& ln, int32_t n_iters, uint8_t* feasible,
   const double lam_max = service_rate(ln, ln.max_batch) * (1.0 - kRateEps);
 
   double ttft_lo, itl_lo, ttft_hi, itl_hi;
-  ttft_itl_at(lam_min, ln, g, &ttft_lo, &itl_lo);
-  ttft_itl_at(lam_max, ln, g, &ttft_hi, &itl_hi);
+  ttft_itl_at(lam_min, ln, g, ttft_tail_margin, &ttft_lo, &itl_lo);
+  ttft_itl_at(lam_max, ln, g, ttft_tail_margin, &ttft_hi, &itl_hi);
 
   double lam_ttft = lam_max, lam_itl = lam_max;
   bool ok_ttft = true, ok_itl = true;
   if (ln.target_ttft > 0.0)
     bisect(ln, g, lam_min, lam_max, ln.target_ttft, ttft_lo, ttft_hi, false,
-           n_iters, &lam_ttft, &ok_ttft);
+           ttft_tail_margin, n_iters, &lam_ttft, &ok_ttft);
   if (ln.target_itl > 0.0)
     bisect(ln, g, lam_min, lam_max, ln.target_itl, itl_lo, itl_hi, true,
-           n_iters, &lam_itl, &ok_itl);
+           1.0, n_iters, &lam_itl, &ok_itl);
   const double lam_tps =
       ln.target_tps > 0.0 ? lam_max * (1.0 - kStabilitySafety) : lam_max;
 
@@ -225,8 +229,8 @@ int inferno_fleet_size(
     const int32_t* occupancy_cap, const double* target_ttft,
     const double* target_itl, const double* target_tps,
     const double* total_rate, const int32_t* min_replicas,
-    const double* cost_per_replica, int32_t n_iters, int32_t n_threads,
-    uint8_t* feasible, double* lambda_star, double* rate_star,
+    const double* cost_per_replica, int32_t n_iters, double ttft_tail_margin,
+    int32_t n_threads, uint8_t* feasible, double* lambda_star, double* rate_star,
     int32_t* num_replicas, double* cost, double* itl, double* ttft,
     double* rho) {
   if (n_lanes < 0 || n_iters <= 0) return 1;
@@ -253,7 +257,7 @@ int inferno_fleet_size(
       num_replicas[i] = 0;
       return;
     }
-    size_lane(ln, n_iters, &feasible[i], &lambda_star[i], &rate_star[i],
+    size_lane(ln, n_iters, ttft_tail_margin, &feasible[i], &lambda_star[i], &rate_star[i],
               &num_replicas[i], &cost[i], &itl[i], &ttft[i], &rho[i]);
   };
 

@@ -29,7 +29,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from inferno_tpu.config.defaults import STABILITY_SAFETY_FRACTION
+from inferno_tpu.config.defaults import SLO_MARGIN, STABILITY_SAFETY_FRACTION
 
 # match the scalar analyzer (inferno_tpu/analyzer/queue.py RATE_EPSILON)
 _RATE_EPSILON = 1e-3
@@ -193,11 +193,17 @@ def _get_solver(use_pallas: bool):
     return pallas_queueing.solve_stats
 
 
-def _ttft_itl_at(lam: jax.Array, p: FleetParams, grid: _Grid, solve=_solve_stats):
+def _ttft_itl_at(
+    lam: jax.Array, p: FleetParams, grid: _Grid, solve=_solve_stats,
+    wait_margin: float = 1.0,
+):
+    """(ttft, itl) at rates `lam`; `wait_margin` scales the queueing-wait
+    component of TTFT to its SLO percentile (queue.size_with_targets —
+    sizing bisects with SLO_MARGIN, reporting uses the mean)."""
     wait, serv, _, _ = solve(lam, grid)
     conc = _concurrency(p, serv)
     prefill = jnp.where(p.in_tokens > 0, p.gamma + p.delta * p.in_tokens * conc, 0.0)
-    return wait + prefill, p.alpha + p.beta * conc
+    return wait_margin * wait + prefill, p.alpha + p.beta * conc
 
 
 def _bisect_increasing(
@@ -253,13 +259,15 @@ def fleet_size(
     k_max: int,
     n_iters: int = DEFAULT_BISECT_ITERS,
     use_pallas: bool = False,
+    ttft_tail_margin: float = SLO_MARGIN,
 ) -> FleetResult:
     """Size every lane: max per-replica rate meeting TTFT/ITL/TPS targets,
     replica count for the offered load, cost, and the expected per-replica
     operating point. The batched equivalent of
     QueueAnalyzer.size + create_allocation's arithmetic
     (reference: pkg/analyzer/queueanalyzer.go:185-255 +
-    pkg/core/allocation.go:126-157)."""
+    pkg/core/allocation.go:126-157). TTFT targets bind at SLO_PERCENTILE
+    via `ttft_tail_margin`, matching queue.size_with_targets."""
     solve = _get_solver(use_pallas)
     grid = _make_grid(params, k_max)
     one = jnp.ones_like(params.alpha)
@@ -269,12 +277,13 @@ def fleet_size(
     lam_max = mu_n * (1.0 - _RATE_EPSILON)
 
     # metric values at both rate bounds, one solve per bound
-    ttft_lo, itl_lo = _ttft_itl_at(lam_min, params, grid, solve)
-    ttft_hi, itl_hi = _ttft_itl_at(lam_max, params, grid, solve)
+    ttft_lo, itl_lo = _ttft_itl_at(lam_min, params, grid, solve, ttft_tail_margin)
+    ttft_hi, itl_hi = _ttft_itl_at(lam_max, params, grid, solve, ttft_tail_margin)
 
     lam_ttft, ok_ttft = _bisect_increasing(
         lam_min, lam_max, params.target_ttft, ttft_lo, ttft_hi,
-        lambda lam: _ttft_itl_at(lam, params, grid, solve)[0], n_iters,
+        lambda lam: _ttft_itl_at(lam, params, grid, solve, ttft_tail_margin)[0],
+        n_iters,
     )
     lam_itl, ok_itl = _bisect_increasing(
         lam_min, lam_max, params.target_itl, itl_lo, itl_hi,
@@ -372,13 +381,16 @@ def _tandem_num_decodes(p: TandemParams) -> jax.Array:
     return jnp.maximum(p.out_tokens - 1.0, 1.0)
 
 
-def _tandem_ttft_at(lam_unit: jax.Array, p: TandemParams, gp: _Grid, solve):
+def _tandem_ttft_at(
+    lam_unit: jax.Array, p: TandemParams, gp: _Grid, solve, wait_margin: float = 1.0
+):
     """TTFT depends only on the prefill stage (DisaggAnalyzer._ttft_at), so
-    the TTFT bisection skips the decode-stage solve entirely."""
+    the TTFT bisection skips the decode-stage solve entirely. `wait_margin`
+    scales the prefill-queue wait to its SLO percentile for sizing."""
     p_slope = p.delta * p.in_tokens
     pwait, pserv, _, _ = solve(lam_unit / p.prefill_slices, gp)
     pconc = _stage_concurrency(pserv, p.gamma, p_slope, gp.nmax)
-    return pwait + p.gamma + p_slope * pconc
+    return wait_margin * pwait + p.gamma + p_slope * pconc
 
 
 def _tandem_eval(lam_unit: jax.Array, p: TandemParams, gp: _Grid, gd: _Grid, solve):
@@ -409,11 +421,13 @@ def tandem_fleet_size(
     k_max: int,
     n_iters: int = DEFAULT_BISECT_ITERS,
     use_pallas: bool = False,
+    ttft_tail_margin: float = SLO_MARGIN,
 ) -> FleetResult:
     """Size every disaggregated lane: batched equivalent of
     build_disagg_analyzer + DisaggAnalyzer.size + create_allocation's
     arithmetic. `k_max` must cover both stages' occupancy caps (callers
-    bucket by max(prefill_cap, decode_cap))."""
+    bucket by max(prefill_cap, decode_cap)). TTFT targets bind at
+    SLO_PERCENTILE via `ttft_tail_margin` (queue.size_with_targets)."""
     solve = _get_solver(use_pallas)
     nd = _tandem_num_decodes(params)
     p_slope = params.delta * params.in_tokens
@@ -437,12 +451,15 @@ def tandem_fleet_size(
     lam_min = unit_max * _RATE_EPSILON
     lam_max = unit_max * (1.0 - _RATE_EPSILON)
 
-    ttft_lo, itl_lo, _, _ = _tandem_eval(lam_min, params, gp, gd, solve)
-    ttft_hi, itl_hi, _, _ = _tandem_eval(lam_max, params, gp, gd, solve)
+    _, itl_lo, _, _ = _tandem_eval(lam_min, params, gp, gd, solve)
+    _, itl_hi, _, _ = _tandem_eval(lam_max, params, gp, gd, solve)
+    ttft_lo = _tandem_ttft_at(lam_min, params, gp, solve, ttft_tail_margin)
+    ttft_hi = _tandem_ttft_at(lam_max, params, gp, solve, ttft_tail_margin)
 
     lam_ttft, ok_ttft = _bisect_increasing(
         lam_min, lam_max, params.target_ttft, ttft_lo, ttft_hi,
-        lambda lam: _tandem_ttft_at(lam, params, gp, solve), n_iters,
+        lambda lam: _tandem_ttft_at(lam, params, gp, solve, ttft_tail_margin),
+        n_iters,
     )
     lam_itl, ok_itl = _bisect_increasing(
         lam_min, lam_max, params.target_itl, itl_lo, itl_hi,

@@ -207,7 +207,7 @@ def test_missing_deployment_skips_variant():
     rec = reconciler(cluster, make_prom())
     report = rec.run_cycle()
     assert report.variants_prepared == 0
-    assert any("deployment" in e for e in report.errors)
+    assert any("workload" in e for e in report.errors)
 
 
 def test_missing_slo_skips_variant():

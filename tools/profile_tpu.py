@@ -124,7 +124,7 @@ def profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out):
             reps = 1
             while reps < 64 and est * reps < args.target_ms:
                 reps *= 4
-            prefill = make_prefill_repeat_fn(dims, n_layers, reps)
+            prefill = make_prefill_repeat_fn(dims, reps)
             x = jnp.ones((b, t, dims.hidden), dtype=jnp.bfloat16) * 0.01
             ms = _timed_ms(lambda: prefill(params, x), args.iters, rtt_ms, reps)
             prefill_out.append(
