@@ -5,7 +5,7 @@ PYTHON ?= python
 IMG ?= inferno-tpu-autoscaler:latest
 CLUSTER ?= inferno-tpu
 
-.PHONY: all test test-unit test-e2e bench native lint \
+.PHONY: all test test-unit test-e2e test-apiserver bench native lint \
         manifests-sync docker-build deploy-kind deploy undeploy clean
 
 all: native test
@@ -19,11 +19,17 @@ test:
 # Math/library tiers only (fast; no HTTP servers).
 test-unit:
 	$(PYTHON) -m pytest tests/ -x -q \
-	  --ignore=tests/test_emulator.py --ignore=tests/test_e2e_http.py
+	  --ignore=tests/test_emulator.py --ignore=tests/test_e2e_http.py \
+	  --ignore=tests/test_apiserver.py
 
 # e2e tier: emulator HTTP server + MiniProm + controller loop over sockets.
 test-e2e:
 	$(PYTHON) -m pytest tests/test_emulator.py tests/test_e2e_http.py -x -q
+
+# API-server tier (envtest analogue): RestKubeClient/watch/leader against
+# MiniApiServer over real sockets, incl. a cycle scaling a Deployment.
+test-apiserver:
+	$(PYTHON) -m pytest tests/test_apiserver.py -x -q
 
 # Benchmark: one JSON line (fleet sizing cycle vs reference algorithm).
 bench:

@@ -270,11 +270,20 @@ class Reconciler:
             if existing.get("kind") == ref["kind"] and existing.get("name") == ref["name"]:
                 return
         # only one controller ref may exist: a workload-kind change
-        # (Deployment -> LWS of the same name) replaces the stale ref
+        # (Deployment -> LWS of the same name) replaces OUR stale ref
         # instead of appending a second controller:True entry, which a real
-        # API server rejects
+        # API server rejects. Controller refs of foreign kinds are left
+        # alone — stealing ownership from another controller breaks its GC
+        # and invites a reconcile fight.
+        ours = {"Deployment", "LeaderWorkerSet"}
+        if any(
+            r.get("controller") and r.get("kind") not in ours
+            for r in va.owner_references
+        ):
+            return
         va.owner_references[:] = [
-            r for r in va.owner_references if not r.get("controller")
+            r for r in va.owner_references
+            if not (r.get("controller") and r.get("kind") in ours)
         ]
         va.owner_references.append(ref)
         if not self.gate():
@@ -496,6 +505,20 @@ class Reconciler:
                     "optimization completed",
                 )
             else:
+                # squeezed out (capacity exhausted / SLO unachievable): the
+                # decision this cycle is the minimum — leaving the stale
+                # desired from an earlier cycle standing would keep the
+                # variant scaled out on chips the solver just reassigned to
+                # higher-priority classes. Floor at 1 unless scale-to-zero
+                # is enabled: scaling to 0 kills the engine's metric
+                # series, which would keep the variant out of the solver
+                # (metrics unavailable) even after capacity frees — a
+                # stranding loop.
+                floor = 0 if self.config.scale_to_zero else 1
+                fresh.status.desired_optimized_alloc.num_replicas = min(
+                    fresh.status.desired_optimized_alloc.num_replicas, floor
+                )
+                fresh.status.desired_optimized_alloc.last_run_time = now
                 fresh.status.set_condition(
                     TYPE_OPTIMIZATION_READY,
                     "False",

@@ -1,0 +1,15 @@
+"""Test-grade infrastructure with real wire semantics (no cluster needed).
+
+`apiserver.MiniApiServer` is this build's envtest: the reference boots a
+real kube-apiserver + etcd in its controller suites
+(/root/reference/internal/controller/suite_test.go:66-84); this image has
+no kind/etcd/docker binaries, so the equivalent here is an in-process HTTP
+server speaking the Kubernetes REST dialect the controller actually uses —
+resourceVersions, merge-patch, subresources, watch streams with 410
+resync, lease optimistic concurrency, and CRD schema validation loaded
+from the committed manifest.
+"""
+
+from inferno_tpu.testing.apiserver import MiniApiServer
+
+__all__ = ["MiniApiServer"]
