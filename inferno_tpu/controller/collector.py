@@ -37,11 +37,16 @@ DEFAULT_MAX_BATCH = 256
 
 @dataclasses.dataclass(frozen=True)
 class MetricsValidation:
-    """(reference MetricsValidationResult: collector.go:79-84)"""
+    """(reference MetricsValidationResult: collector.go:79-84).
+
+    `running` carries the probed num_requests_running sum (the validation
+    query's own payload): the profile corrector uses it as the observed
+    fleet concurrency without a sixth query."""
 
     available: bool
     reason: str
     message: str
+    running: float = 0.0
 
 
 def fix_value(x: float) -> float:
@@ -107,7 +112,10 @@ def validate_metrics_availability(
                 f"(last update {age:.0f}s ago).",
             )
     return MetricsValidation(
-        True, REASON_METRICS_FOUND, f"{engine.name} metrics are available and fresh"
+        True,
+        REASON_METRICS_FOUND,
+        f"{engine.name} metrics are available and fresh",
+        running=sum(fix_value(s.value) for s in samples),
     )
 
 

@@ -87,6 +87,10 @@ class ReconcilerConfig:
             )
     direct_scale: bool = False  # actuate Deployments directly (no HPA)
     interval_seconds: int = DEFAULT_INTERVAL_SECONDS
+    # calibrate CR-carried linear profiles against observed telemetry,
+    # consulting the learned surrogate where residuals are large
+    # (models/corrector.py); disable for reference-exact static profiles
+    profile_correction: bool = True
 
 
 @dataclasses.dataclass
@@ -123,6 +127,12 @@ class Reconciler:
             kube=kube, emitter=self.emitter, direct_scale=self.config.direct_scale
         )
         self.log = get_logger("inferno.reconciler")
+        if self.config.profile_correction:
+            from inferno_tpu.models.corrector import ProfileCorrector
+
+            self.corrector = ProfileCorrector()
+        else:
+            self.corrector = None
         # set by a Watcher (or anyone) to trigger the next cycle early
         self._wake = threading.Event()
         # Leadership gate, re-checked at every write: a leader deposed
@@ -373,12 +383,66 @@ class Reconciler:
             return False
         va.status.current_alloc = current
 
-        for prof in matching_profiles:
-            spec.models.append(
-                prof.to_perf_spec(
-                    model_key, avg_in_tokens=current.load.avg_input_tokens
-                )
+        # profile correction: feed this cycle's observation, compute the
+        # current slice shape's corrected parms once, and carry the
+        # multiplicative residual onto the other candidate shapes (their
+        # miscalibration is assumed systematic; only the running shape has
+        # direct telemetry)
+        corr_key = ""
+        corr_decode = corr_prefill = corr_state = None
+        if self.corrector is not None:
+            from inferno_tpu.models.corrector import Observation
+
+            acc_now = current.accelerator or matching_profiles[0].acc
+            corr_key = f"{va.full_name}@{acc_now}"
+            replicas = max(current.num_replicas, 1)
+            self.corrector.observe(
+                corr_key,
+                Observation(
+                    concurrency=validation.running / replicas,
+                    in_tokens=current.load.avg_input_tokens,
+                    out_tokens=current.load.avg_output_tokens,
+                    itl_ms=current.itl_average,
+                    ttft_ms=current.ttft_average,
+                ),
             )
+
+        for prof in matching_profiles:
+            perf = prof.to_perf_spec(
+                model_key, avg_in_tokens=current.load.avg_input_tokens
+            )
+            if self.corrector is not None and f"{va.full_name}@{prof.acc}" == corr_key:
+                corr_decode, corr_prefill, corr_state = self.corrector.corrected_parms(
+                    corr_key, perf.decode_parms, perf.prefill_parms
+                )
+                if corr_state.active:
+                    self.log.info(
+                        "profile correction active for %s: decode x%.2f "
+                        "prefill x%.2f (surrogate=%s, %d obs)",
+                        corr_key, corr_state.decode_ratio,
+                        corr_state.prefill_ratio, corr_state.surrogate_used,
+                        corr_state.observations,
+                    )
+                    perf.decode_parms, perf.prefill_parms = corr_decode, corr_prefill
+            spec.models.append(perf)
+
+        if corr_state is not None and corr_state.active:
+            # the running shape has direct telemetry; the other candidate
+            # shapes carry the multiplicative residual (assumed systematic)
+            for perf in spec.models[-len(matching_profiles):]:
+                if f"{va.full_name}@{perf.acc}" == corr_key:
+                    continue  # already surrogate/ratio-corrected directly
+                perf.decode_parms = dataclasses.replace(
+                    perf.decode_parms,
+                    alpha=perf.decode_parms.alpha * corr_state.decode_ratio,
+                    beta=perf.decode_parms.beta * corr_state.decode_ratio,
+                )
+                if corr_state.prefill_ratio != 1.0:
+                    perf.prefill_parms = dataclasses.replace(
+                        perf.prefill_parms,
+                        gamma=perf.prefill_parms.gamma * corr_state.prefill_ratio,
+                        delta=perf.prefill_parms.delta * corr_state.prefill_ratio,
+                    )
 
         # server entry (reference AddServerInfoToSystemData: utils.go:237-311)
         min_replicas = 0 if self.config.scale_to_zero else 1
@@ -423,6 +487,8 @@ class Reconciler:
             report.optimization_ok = False
             return report
         report.variants_seen = len(vas)
+        if self.corrector is not None:
+            self.corrector.prune({va.full_name for va in vas})
         if not vas:
             return report
 
@@ -514,9 +580,11 @@ class Reconciler:
                 # series, which would keep the variant out of the solver
                 # (metrics unavailable) even after capacity frees — a
                 # stranding loop.
-                floor = 0 if self.config.scale_to_zero else 1
-                fresh.status.desired_optimized_alloc.num_replicas = min(
-                    fresh.status.desired_optimized_alloc.num_replicas, floor
+                # exactly the minimum, not min(stale, floor): a fresh VA's
+                # stale desired is 0, and clamping against it would scale a
+                # never-optimized variant to zero with scale-to-zero off
+                fresh.status.desired_optimized_alloc.num_replicas = (
+                    0 if self.config.scale_to_zero else 1
                 )
                 fresh.status.desired_optimized_alloc.last_run_time = now
                 fresh.status.set_condition(

@@ -30,6 +30,11 @@ class EngineProfile:
     delta: float = 0.02
     max_batch: int = 64
     kv_tokens_capacity: int = 1_000_000  # KV cache budget in tokens
+    # quadratic decode term: real engines bend super-linearly as the KV
+    # working set spills cache tiers; lets tests emulate a true profile
+    # that the CR's linear alpha/beta does NOT capture (the profile-
+    # corrector's closed-loop scenario)
+    beta2: float = 0.0
 
 
 @dataclasses.dataclass
@@ -164,7 +169,7 @@ class EmulatedEngine:
                     self._last_tick_wall = time.time()
                 continue
             # one iteration: prefill for newly admitted + one decode step
-            step_ms = p.alpha + p.beta * batch
+            step_ms = p.alpha + p.beta * batch + p.beta2 * batch * batch
             if new:
                 in_toks = max(r.in_tokens for r in new)
                 step_ms += p.gamma + p.delta * in_toks * batch

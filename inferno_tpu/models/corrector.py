@@ -1,0 +1,228 @@
+"""Profile corrector: closes the loop between CR-carried linear profiles
+and observed telemetry, consulting the learned surrogate where the linear
+model's residuals are large.
+
+The reference ships profiles as static CR fields and never validates them
+against reality (SURVEY §0: the decision engine is purely analytic). Here
+each reconcile cycle feeds an observation — per-replica concurrency,
+request shape, observed ITL/TTFT — into a per-variant ring buffer. When
+the median decode residual (observed / predicted ITL at the observed
+concurrency) leaves the calibration band:
+
+1. the surrogate (models/surrogate.py, trained on this variant's window
+   with parallel/train.py's dp x tp SPMD step) learns the true
+   latency(batch) shape, non-linearities included;
+2. its predictions over the *observed concurrency range* are re-fit to
+   the linear alpha + beta*batch form the sizing kernels consume — a
+   local linearization around the operating point, so every backend
+   (scalar, XLA fleet kernel, pallas, C++) benefits without interface
+   changes;
+3. prefill gamma/delta get a bounded multiplicative residual correction
+   (TTFT observations fold queueing wait in, so a shape-refit would chase
+   noise there).
+
+With fewer observations than the surrogate needs, correction falls back
+to the same bounded multiplicative scaling for decode, so calibration
+degrades gracefully rather than flapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import deque
+
+import numpy as np
+
+from inferno_tpu.config.types import DecodeParms, PrefillParms
+
+RESIDUAL_BAND = 1.2  # |log-ratio| beyond log(this) triggers correction
+MIN_OBSERVATIONS = 6
+SURROGATE_MIN_OBSERVATIONS = 12
+WINDOW = 64
+CORRECTION_BOUNDS = (0.25, 4.0)  # clamp on multiplicative corrections
+
+
+@dataclasses.dataclass(frozen=True)
+class Observation:
+    concurrency: float  # observed per-replica batch occupancy
+    in_tokens: float
+    out_tokens: float
+    itl_ms: float  # observed inter-token latency
+    ttft_ms: float  # observed time-to-first-token (incl. queueing)
+
+
+@dataclasses.dataclass
+class CorrectionState:
+    active: bool = False
+    decode_ratio: float = 1.0
+    prefill_ratio: float = 1.0
+    surrogate_used: bool = False
+    observations: int = 0
+
+
+def _clamp(x: float) -> float:
+    return float(min(max(x, CORRECTION_BOUNDS[0]), CORRECTION_BOUNDS[1]))
+
+
+class ProfileCorrector:
+    """Per-variant calibration of linear perf profiles from telemetry."""
+
+    def __init__(
+        self,
+        residual_band: float = RESIDUAL_BAND,
+        window: int = WINDOW,
+        use_surrogate: bool = True,
+    ):
+        self.residual_band = residual_band
+        self.use_surrogate = use_surrogate
+        self.window = window
+        self._obs: dict[str, deque[Observation]] = {}
+        self._state: dict[str, CorrectionState] = {}
+        # surrogate refits are expensive (jit + epochs): cache per key and
+        # only retrain after the window accrues materially new evidence
+        self._refit_cache: dict[str, tuple[int, DecodeParms | None]] = {}
+        self.refit_every = 8  # new observations between retrains
+        self._seen: dict[str, int] = {}  # total observations ever per key
+
+    def prune(self, active_prefixes: set[str]) -> None:
+        """Drop state for variants no longer reconciled (key format
+        "<variant full name>@<acc>"): a long-lived controller must not
+        accumulate windows for deleted VAs forever."""
+        for store in (self._obs, self._state, self._refit_cache, self._seen):
+            for key in [k for k in store if k.split("@", 1)[0] not in active_prefixes]:
+                del store[key]
+
+    def observe(self, key: str, obs: Observation) -> None:
+        """Record one cycle's observation for a variant. Zero/garbage
+        telemetry (idle variant, scrape gap) is skipped."""
+        if obs.itl_ms <= 0 or obs.concurrency <= 0:
+            return
+        self._obs.setdefault(key, deque(maxlen=self.window)).append(obs)
+        self._seen[key] = self._seen.get(key, 0) + 1
+
+    def state(self, key: str) -> CorrectionState:
+        return self._state.get(key, CorrectionState())
+
+    # -- correction ----------------------------------------------------------
+
+    def corrected_parms(
+        self, key: str, decode: DecodeParms, prefill: PrefillParms
+    ) -> tuple[DecodeParms, PrefillParms, CorrectionState]:
+        """Profile parms to use for sizing this cycle: unchanged while the
+        linear profile tracks reality, corrected once residuals leave the
+        calibration band."""
+        window = list(self._obs.get(key, ()))
+        state = CorrectionState(observations=len(window))
+        if len(window) < MIN_OBSERVATIONS:
+            self._state[key] = state
+            return decode, prefill, state
+
+        conc = np.array([o.concurrency for o in window])
+        obs_itl = np.array([o.itl_ms for o in window])
+        pred_itl = decode.alpha + decode.beta * conc
+        log_ratio = np.log(obs_itl / np.maximum(pred_itl, 1e-9))
+        median_ratio = float(np.exp(np.median(log_ratio)))
+
+        if abs(math.log(max(median_ratio, 1e-9))) <= math.log(self.residual_band):
+            self._state[key] = state
+            return decode, prefill, state
+
+        state.active = True
+        state.decode_ratio = _clamp(median_ratio)
+
+        new_decode: DecodeParms | None = None
+        if self.use_surrogate and len(window) >= SURROGATE_MIN_OBSERVATIONS:
+            seen = self._seen.get(key, len(window))
+            cached = self._refit_cache.get(key)
+            if cached is not None and seen - cached[0] < self.refit_every:
+                new_decode = cached[1]
+            else:
+                new_decode = self._surrogate_refit(window, decode)
+                self._refit_cache[key] = (seen, new_decode)
+            state.surrogate_used = new_decode is not None
+        if new_decode is None:
+            # graceful fallback: bounded multiplicative rescale
+            new_decode = DecodeParms(
+                alpha=decode.alpha * state.decode_ratio,
+                beta=decode.beta * state.decode_ratio,
+            )
+
+        # prefill: bounded ratio on the prefill-only component. Observed
+        # TTFT includes queue wait, so only correct when observation is
+        # clearly above prediction (wait inflates, never deflates).
+        obs_ttft = np.array([o.ttft_ms for o in window])
+        in_toks = np.array([o.in_tokens for o in window])
+        pred_prefill = prefill.gamma + prefill.delta * in_toks * conc
+        p_ratio = float(np.exp(np.median(np.log(
+            np.maximum(obs_ttft, 1e-9) / np.maximum(pred_prefill, 1e-9)
+        ))))
+        new_prefill = prefill
+        if p_ratio > self.residual_band:
+            state.prefill_ratio = _clamp(p_ratio)
+            new_prefill = PrefillParms(
+                gamma=prefill.gamma * state.prefill_ratio,
+                delta=prefill.delta * state.prefill_ratio,
+            )
+
+        self._state[key] = state
+        return new_decode, new_prefill, state
+
+    def _surrogate_refit(
+        self, window: list[Observation], decode: DecodeParms
+    ) -> DecodeParms | None:
+        """Train the surrogate on the window, then linearize its ITL
+        prediction over the observed concurrency range."""
+        conc = np.array([o.concurrency for o in window])
+        lo, hi = float(conc.min()), float(conc.max())
+        if hi - lo < 1.0:
+            return None  # no spread: a line through one point is noise
+        try:
+            from inferno_tpu.models.surrogate import featurize, surrogate_forward
+            from inferno_tpu.parallel.train import fit_surrogate, train_mesh
+
+            def feats(c: np.ndarray, in_toks: np.ndarray, out_toks: np.ndarray) -> np.ndarray:
+                n = c.shape[0]
+                ones = np.ones(n)
+                return featurize(
+                    chips=ones, cost_per_chip=ones,
+                    alpha=np.full(n, decode.alpha), beta=np.full(n, decode.beta),
+                    gamma=ones, delta=ones,
+                    batch=c,
+                    in_tokens=in_toks,
+                    out_tokens=out_toks,
+                    rate=ones,
+                )
+
+            obs_in = np.array([o.in_tokens for o in window])
+            obs_out = np.array([o.out_tokens for o in window])
+            x = feats(conc, obs_in, obs_out)
+            y = np.stack(
+                [
+                    np.log1p([o.itl_ms for o in window]),
+                    np.log1p([o.ttft_ms for o in window]),
+                    np.zeros(len(window)),
+                ],
+                axis=-1,
+            ).astype(np.float32)
+            mesh = train_mesh(tp=1)
+            state, losses = fit_surrogate(x, y, mesh=mesh, epochs=80, learning_rate=3e-3)
+
+            probe = np.linspace(lo, hi, 16)
+            px = feats(
+                probe,
+                np.full(16, float(obs_in.mean())),
+                np.full(16, float(obs_out.mean())),
+            )
+            pred = np.asarray(surrogate_forward(state.params, px, state.cfg))
+            itl_pred = np.expm1(pred[:, 0])
+            if not np.all(np.isfinite(itl_pred)) or np.any(itl_pred <= 0):
+                return None
+            a_mat = np.stack([np.ones_like(probe), probe], axis=1)
+            coef, *_ = np.linalg.lstsq(a_mat, itl_pred, rcond=None)
+            alpha, beta = float(coef[0]), float(coef[1])
+            if alpha <= 0 or beta < 0:
+                return None
+            return DecodeParms(alpha=alpha, beta=beta)
+        except Exception:
+            return None  # any training failure falls back to ratio scaling

@@ -179,6 +179,110 @@ def _layer(x, layer_p, kv_cache, positions, mask, dims: LlamaDims):
     return x, kv_cache
 
 
+def _mixed_layer(x_all, split_b, layer_p, kv_cache, positions_dec, pos_chunk, mask_dec, mask_chunk, dims):
+    """One decoder layer over a continuous-batching iteration: `split_b`
+    decode rows + one prefill chunk, SHARING every weight matmul (the rows
+    are concatenated for all projections, so the weight read amortizes the
+    way a real chunked-prefill engine's step does), with attention split
+    per group. x_all: (B + T, H). Returns (x_all, new_cache)."""
+    b = split_b
+    h = _rmsnorm(x_all, layer_p["norm_attn"])
+    q = _mm(h, layer_p["wq"])
+    k = _mm(h, layer_p["wk"])
+    v = _mm(h, layer_p["wv"])
+
+    # decode group: (B, 1, heads, hd)
+    qd = q[:b].reshape(b, 1, dims.n_heads, dims.head_dim)
+    kd = k[:b].reshape(b, 1, dims.n_kv_heads, dims.head_dim)
+    vd = v[:b].reshape(b, 1, dims.n_kv_heads, dims.head_dim)
+    qd = _rope(qd, positions_dec, dims.rope_theta)
+    kd = _rope(kd, positions_dec, dims.rope_theta).transpose(0, 2, 1, 3)
+    vd = vd.transpose(0, 2, 1, 3)
+    start = positions_dec[0, 0]
+    k_all = lax.dynamic_update_slice(kv_cache[0], kd, (0, 0, start, 0))
+    v_all = lax.dynamic_update_slice(kv_cache[1], vd, (0, 0, start, 0))
+    attn_d = _gqa_attend(qd, k_all, v_all, mask_dec, dims).reshape(b, dims.q_dim)
+
+    # chunk group: (1, T, heads, hd), causal within the chunk
+    t = x_all.shape[0] - b
+    qc = q[b:].reshape(1, t, dims.n_heads, dims.head_dim)
+    kc = k[b:].reshape(1, t, dims.n_kv_heads, dims.head_dim)
+    vc = v[b:].reshape(1, t, dims.n_kv_heads, dims.head_dim)
+    qc = _rope(qc, pos_chunk, dims.rope_theta)
+    kc = _rope(kc, pos_chunk, dims.rope_theta).transpose(0, 2, 1, 3)
+    vc = vc.transpose(0, 2, 1, 3)
+    attn_c = _gqa_attend(qc, kc, vc, mask_chunk, dims).reshape(t, dims.q_dim)
+
+    attn = jnp.concatenate([attn_d, attn_c], axis=0)
+    x_all = x_all + _mm(attn, layer_p["wo"])
+    h = _rmsnorm(x_all, layer_p["norm_mlp"])
+    gated = jax.nn.silu(_mm(h, layer_p["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    x_all = x_all + _mm(gated * _mm(h, layer_p["w_up"]), layer_p["w_down"])
+    return x_all, (k_all, v_all)
+
+
+def make_mixed_fn(dims: LlamaDims, n_layers: int, n_steps: int):
+    """Jittable continuous-batching iteration: a batch of B decoding
+    sequences plus ONE T-token prefill chunk per step, projections shared.
+
+    Timing this per step measures the quantity the reference's TTFT
+    calibration actually observes (guidellm TTFT at concurrency B under
+    vLLM continuous batching = the arriving request's chunk riding a
+    shared iteration, /root/reference/docs/tutorials/
+    parameter-estimation.md:241-266) — NOT B serialized full prefills.
+
+    (params, x_dec (B,1,H), caches flat tuple, chunk (T,H), start_pos)
+    -> (scalar, x_dec, caches).
+    """
+
+    def one_step(params, x_dec, caches, chunk, pos):
+        b = x_dec.shape[0]
+        t = chunk.shape[0]
+        s_max = caches[0].shape[2]
+        positions_dec = jnp.broadcast_to(pos, (b, 1))
+        valid = jnp.arange(s_max)[None, None, :] <= pos
+        mask_dec = jnp.broadcast_to(
+            jnp.where(valid, 0.0, -jnp.inf).astype(jnp.float32), (b, 1, s_max)
+        )
+        pos_chunk = jnp.broadcast_to(jnp.arange(t), (1, t))
+        causal = jnp.where(
+            jnp.arange(t)[:, None] >= jnp.arange(t)[None, :], 0.0, -jnp.inf
+        ).astype(jnp.float32)
+        mask_chunk = jnp.broadcast_to(causal, (1, t, t))
+
+        x_all = jnp.concatenate([x_dec[:, 0, :], chunk], axis=0)
+        new_caches = []
+        for li in range(n_layers):
+            layer_p = jax.tree.map(lambda w: w[li], params["layers"])
+            x_all, (k_c, v_c) = _mixed_layer(
+                x_all, b, layer_p, (caches[2 * li], caches[2 * li + 1]),
+                positions_dec, pos_chunk, mask_dec, mask_chunk, dims,
+            )
+            new_caches.extend([k_c, v_c])
+        x_all = _rmsnorm(x_all, params["norm_out"])
+        logits = _mm(x_all, params["lm_head"])  # decode rows + chunk tail all sampled
+        nxt = jnp.tanh(logits[:b, : dims.hidden]).astype(jnp.bfloat16)[:, None, :]
+        return nxt, tuple(new_caches), jnp.sum(logits.astype(jnp.float32))
+
+    def mixed(params, x_dec, caches, chunk, start_pos):
+        def body(i, carry):
+            x_dec, caches, acc = carry
+            # perturb the chunk through the accumulated scalar so no
+            # iteration's chunk work can be hoisted or CSE'd
+            x_dec, caches, s = one_step(
+                params, x_dec, caches, chunk * (1.0 + acc * 1e-30).astype(chunk.dtype),
+                start_pos + i,
+            )
+            return (x_dec, caches, acc + s * 1e-30)
+
+        x_dec, caches, acc = lax.fori_loop(
+            0, n_steps, body, (x_dec, caches, jnp.float32(0.0))
+        )
+        return acc + jnp.sum(x_dec.astype(jnp.float32)), x_dec, caches
+
+    return jax.jit(mixed)
+
+
 def make_prefill_repeat_fn(dims: LlamaDims, reps: int):
     """Jittable repeated prefill for profiling on high-RTT device tunnels:
     runs the causal forward `reps` times inside one compiled call, each

@@ -51,9 +51,14 @@ def _extrapolate_layers(
         groups.setdefault(tuple(s[k] for k in group_keys), []).append(s)
     out = []
     worst_r2 = 1.0
+    skipped = 0
     for gkey, pts in sorted(groups.items()):
         if len(pts) < 2:
-            raise ValueError(f"need >=2 layer depths per point, got {gkey}: {pts}")
+            # a partially-measured sweep (e.g. resumed after a tunnel
+            # outage) may have single-depth groups: skip them rather than
+            # reject the whole file — unless nothing is extrapolatable
+            skipped += 1
+            continue
         ls = np.array([p["n_layers"] for p in pts], dtype=np.float64)
         ts = np.array([p[key] for p in pts], dtype=np.float64)
         a_mat = np.stack([np.ones_like(ls), ls], axis=1)
@@ -68,6 +73,11 @@ def _extrapolate_layers(
         rec = dict(zip(group_keys, gkey))
         rec[key] = full
         out.append(rec)
+    if not out:
+        raise ValueError(
+            f"need >=2 layer depths for at least one point; "
+            f"all {skipped} groups single-depth"
+        )
     return out, worst_r2
 
 
@@ -89,8 +99,31 @@ def synthesize_full_model(raw: Mapping[str, Any], n_layers_full: int = 32):
 
 
 def fit_tpu_profile(raw: Mapping[str, Any], n_layers_full: int = 32):
-    """FittedProfile + synthesis metadata from a raw measurement file."""
+    """FittedProfile + synthesis metadata from a raw measurement file.
+
+    TTFT (gamma/delta) calibration prefers the `mixed` sweep — per-step
+    time of a continuous-batching iteration (decode batch + one prefill
+    chunk sharing the weight pass, llama_block.make_mixed_fn). That is the
+    quantity the reference's guidellm methodology actually observes for
+    TTFT-vs-concurrency (parameter-estimation.md:241-266: TTFT at B=64 is
+    ~one request's chunk riding a shared iteration, NOT 64 serialized
+    prefills), so fitting delta from full-batch prefill times would
+    overstate the TPU's TTFT response ~B-fold relative to how the A100
+    baseline's delta was derived. Raw files without a mixed sweep fall
+    back to the full-batch prefill samples (conservative)."""
     decode, prefill, meta = synthesize_full_model(raw, n_layers_full)
+    if raw.get("mixed"):
+        ttft_pts, m_r2 = _extrapolate_layers(
+            list(raw["mixed"]), "step_ms", ("batch", "in_tokens"), n_layers_full
+        )
+        meta["ttft_calibration"] = "mixed-step"
+        meta["mixed_layer_linearity_r2"] = round(m_r2, 5)
+        prefill = [
+            {"batch": p["batch"], "in_tokens": p["in_tokens"], "prefill_ms": p["step_ms"]}
+            for p in ttft_pts
+        ]
+    else:
+        meta["ttft_calibration"] = "full-batch-prefill"
     fitted = fit_profile(
         decode_batch=np.array([p["batch"] for p in decode]),
         decode_itl_ms=np.array([p["step_ms"] for p in decode]),
@@ -193,8 +226,6 @@ def build_profile_json(
     derived = n_chips > 1
     if derived:
         fitted = derive_tensor_parallel(fitted, n_chips, n_layers=n_layers_full, hidden=dims.hidden)
-        # multi-chip serving fits bf16 weights
-        weight_bytes_per_param = 2.0
     max_batch = max_batch_from_memory(
         dims, hbm_per_chip_gb, at_tokens,
         weight_bytes_per_param=weight_bytes_per_param, n_chips=n_chips,

@@ -112,6 +112,7 @@ class _Store:
     def __init__(self):
         self.lock = threading.Condition()
         self.rv = itertools.count(1)
+        self.last_rv = 0
         self.objects: dict[tuple, dict] = {}  # (kind_key, ns, name) -> object
         # kind_key -> list of (rv:int, type:str, object:dict)
         self.events: dict[str, list] = {}
@@ -119,7 +120,8 @@ class _Store:
         self.uid = itertools.count(1000)
 
     def next_rv(self) -> int:
-        return next(self.rv)
+        self.last_rv = next(self.rv)
+        return self.last_rv
 
     def record(self, kind_key: str, event_type: str, obj: dict) -> None:
         log = self.events.setdefault(kind_key, [])
@@ -366,9 +368,9 @@ class MiniApiServer:
     # -- helpers -------------------------------------------------------------
 
     def _current_rv(self) -> int:
-        # peek without consuming
-        rv = self.store.next_rv()
-        return rv
+        """Peek the last issued resourceVersion without consuming one (two
+        LISTs with no intervening writes must return the same rv)."""
+        return self.store.last_rv
 
     def _stamp(self, kind: str, ns: str | None, name: str, body: dict, uid: str | None = None) -> dict:
         stored = copy.deepcopy(body)
@@ -409,6 +411,20 @@ class MiniApiServer:
         handler.send_header("Transfer-Encoding", "chunked")
         handler.end_headers()
 
+        initial = []
+        if since == 0:
+            # rv-less watch: Kubernetes "get state and start at most
+            # recent" — synthetic ADDED for current objects, then events
+            # from now; never replays the historical event log and is
+            # immune to compaction
+            with self.store.lock:
+                initial = [
+                    copy.deepcopy(obj)
+                    for (k, o_ns, _), obj in sorted(self.store.objects.items())
+                    if k == kind and (ns is None or o_ns == ns)
+                ]
+                since = self._current_rv()
+
         def send_line(payload: dict) -> bool:
             data = json.dumps(payload).encode() + b"\n"
             try:
@@ -419,6 +435,9 @@ class MiniApiServer:
                 return False
 
         last = since
+        for obj in initial:
+            if not send_line({"type": "ADDED", "object": obj}):
+                return
         while time.time() < deadline:
             with self.store.lock:
                 floor = self.store.compaction_floor.get(kind, 0)

@@ -1,0 +1,181 @@
+"""Profile corrector: residual detection, ratio fallback, surrogate
+refit on non-linear telemetry, and the closed-loop reconciler behavior
+(models/corrector.py; VERDICT r2 item 6 — the surrogate wired into the
+decision path)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from inferno_tpu.config.types import DecodeParms, PrefillParms
+from inferno_tpu.models.corrector import (
+    MIN_OBSERVATIONS,
+    Observation,
+    ProfileCorrector,
+)
+
+DEC = DecodeParms(alpha=5.0, beta=0.1)
+PRE = PrefillParms(gamma=2.0, delta=0.01)
+
+
+def obs(conc, itl, ttft=3.0, in_tok=16, out_tok=64):
+    return Observation(concurrency=conc, in_tokens=in_tok, out_tokens=out_tok,
+                       itl_ms=itl, ttft_ms=ttft)
+
+
+def feed(c: ProfileCorrector, key: str, points):
+    for conc, itl in points:
+        c.observe(key, obs(conc, itl))
+
+
+def test_calibrated_profile_unchanged():
+    c = ProfileCorrector()
+    # observations right on the linear model: within band, no correction
+    feed(c, "v", [(b, 5.0 + 0.1 * b) for b in (1, 2, 4, 6, 8, 10, 12, 14)])
+    dec, pre, state = c.corrected_parms("v", DEC, PRE)
+    assert not state.active
+    assert (dec, pre) == (DEC, PRE)
+
+
+def test_too_few_observations_no_correction():
+    c = ProfileCorrector()
+    feed(c, "v", [(8, 50.0)] * (MIN_OBSERVATIONS - 1))
+    _, _, state = c.corrected_parms("v", DEC, PRE)
+    assert not state.active
+
+
+def test_garbage_observations_skipped():
+    c = ProfileCorrector()
+    for _ in range(20):
+        c.observe("v", obs(0.0, 0.0))  # idle cycles
+    _, _, state = c.corrected_parms("v", DEC, PRE)
+    assert state.observations == 0
+
+
+def test_ratio_fallback_without_spread():
+    c = ProfileCorrector()
+    # all observations at the same concurrency, 2x the predicted ITL
+    pred = 5.0 + 0.1 * 8
+    feed(c, "v", [(8.0, 2.0 * pred)] * 10)
+    dec, _, state = c.corrected_parms("v", DEC, PRE)
+    assert state.active and not state.surrogate_used
+    assert dec.alpha == pytest.approx(DEC.alpha * 2.0, rel=0.05)
+    assert dec.beta == pytest.approx(DEC.beta * 2.0, rel=0.05)
+
+
+def test_surrogate_refit_beats_ratio_on_nonlinear_truth():
+    """True ITL bends quadratically; the linear CR profile underestimates
+    at high batch. The surrogate-refit linearization over the observed
+    range must predict the operating region better than a pure ratio
+    rescale of the (wrongly-shaped) CR line."""
+    beta2 = 0.15
+    true_itl = lambda b: DEC.alpha + DEC.beta * b + beta2 * b * b
+    rng = np.random.default_rng(0)
+    c = ProfileCorrector()
+    concs = rng.uniform(2.0, 16.0, size=24)
+    for b in concs:
+        c.observe("v", obs(float(b), true_itl(b) * float(rng.uniform(0.97, 1.03))))
+    dec, _, state = c.corrected_parms("v", DEC, PRE)
+    assert state.active
+    assert state.surrogate_used, "expected the surrogate path with spread + mass"
+
+    probe = np.linspace(4.0, 16.0, 7)
+    refit_err = np.abs(dec.alpha + dec.beta * probe - true_itl(probe)) / true_itl(probe)
+    ratio = state.decode_ratio
+    ratio_err = np.abs(
+        (DEC.alpha + DEC.beta * probe) * ratio - true_itl(probe)
+    ) / true_itl(probe)
+    assert float(refit_err.mean()) < float(ratio_err.mean())
+    # and it is a real improvement over the uncorrected line
+    raw_err = np.abs(DEC.alpha + DEC.beta * probe - true_itl(probe)) / true_itl(probe)
+    assert float(refit_err.mean()) < 0.5 * float(raw_err.mean())
+
+
+def test_e2e_correction_raises_sizing_under_nonlinear_engine():
+    """Closed loop (the VERDICT item-6 scenario): the emulated engine's
+    true decode latency is super-linear (beta2 > 0) while the CR carries
+    only the linear parms. Early cycles under-provision; once the
+    corrector accumulates residual evidence it recalibrates the profile
+    and the desired replica count rises."""
+    from inferno_tpu.controller import InMemoryCluster, Reconciler, ReconcilerConfig
+    from inferno_tpu.controller.crd import (
+        ACCELERATOR_LABEL,
+        AcceleratorProfile,
+        ConfigMapKeyRef,
+        VariantAutoscaling,
+        VariantAutoscalingSpec,
+    )
+    from inferno_tpu.emulator import (
+        EmulatedEngine,
+        EngineProfile,
+        LoadGenerator,
+        MiniProm,
+        RateSpec,
+    )
+
+    MODEL, NS, CFG_NS = "emulated/nl", "workloads", "inferno-system"
+    # true engine: strong quadratic term the linear profile misses
+    # beta2 sized so the corrected profile still fits the ITL SLO but
+    # needs visibly more replicas (too large and sizing goes infeasible,
+    # flooring at min replicas instead of scaling out)
+    true = EngineProfile(alpha=5.0, beta=0.1, gamma=2.0, delta=0.01,
+                         max_batch=8, beta2=0.15)
+    engine = EmulatedEngine(true)
+    engine.start()
+    prom_srv = MiniProm.for_engines({MODEL: [engine]}, labels={"namespace": NS})
+    prom_srv.start()
+
+    cluster = InMemoryCluster()
+    cluster.set_configmap(CFG_NS, "accelerator-unit-costs",
+                          {"v5e-4": json.dumps({"cost": 10.0})})
+    cluster.set_configmap(CFG_NS, "service-classes-config", {
+        "premium.yaml": ("name: Premium\npriority: 1\ndata:\n"
+                         f"  - model: {MODEL}\n    slo-ttft: 400\n    slo-tpot: 30\n"),
+    })
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {})
+    cluster.add_variant_autoscaling(VariantAutoscaling(
+        name="nl", namespace=NS, labels={ACCELERATOR_LABEL: "v5e-4"},
+        spec=VariantAutoscalingSpec(
+            model_id=MODEL,
+            slo_class_ref=ConfigMapKeyRef(name="service-classes-config", key="Premium"),
+            accelerators=[AcceleratorProfile(
+                acc="v5e-4", acc_count=1, max_batch_size=true.max_batch, at_tokens=16,
+                decode_parms=DecodeParms(alpha=true.alpha, beta=true.beta),
+                prefill_parms=PrefillParms(gamma=true.gamma, delta=true.delta),
+            )],
+        ),
+    ))
+    cluster.add_deployment(NS, "nl", replicas=1)
+
+    rec = Reconciler(
+        kube=cluster, prom=prom_srv.client(),
+        config=ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar",
+                                direct_scale=True),
+    )
+    # the corrector must disable the surrogate's jit-train path here: the
+    # closed loop only needs the residual recalibration, and training
+    # inside a timed loop makes the test minutes long on CPU
+    rec.corrector.use_surrogate = False
+    try:
+        gen = LoadGenerator([engine], RateSpec(phases=((10.0, 25.0),)),
+                            in_tokens=16, out_tokens=64, seed=3)
+        gen.start()
+        time.sleep(1.2)
+        desired = []
+        for _ in range(8):
+            report = rec.run_cycle()
+            assert report.errors == []
+            va = cluster.get_variant_autoscaling(NS, "nl")
+            desired.append(va.status.desired_optimized_alloc.num_replicas)
+            time.sleep(0.6)
+        gen.join(20)
+        state = rec.corrector.state(f"nl:{NS}@v5e-4")
+        assert state.active, (state, desired)
+        assert state.decode_ratio > 1.2
+        # recalibration raises the sizing vs the uncorrected early cycles
+        assert max(desired[-2:]) > desired[0], desired
+    finally:
+        prom_srv.stop()
+        engine.stop()

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Build the committed profiles/*.json from raw on-chip measurements.
+
+Inputs (written by tools/profile_tpu.py on the real chip):
+  profiles/raw/llama-3.1-8b_tpu.json       bf16 weights
+  profiles/raw/llama-3.1-8b_tpu_int8.json  int8 weights (w8a16)
+
+Outputs:
+  profiles/llama-3.1-8b_v5e-1.json   MEASURED (int8 raw): the only
+      memory-feasible single-chip serving config for an 8B — bf16 weights
+      alone exceed one v5e chip's 16 GB HBM.
+  profiles/llama-3.1-8b_v5e-1-bf16.json  MEASURED (bf16 raw): compute
+      reference point; maxBatchSize is 0 because the config does not fit
+      one chip — kept for fit transparency, not for the optimizer.
+  profiles/llama-3.1-8b_v5e-4.json / _v5e-8.json  DERIVED from the bf16
+      measurement (bf16 weights fit at TP>=4): per-chip traffic divided,
+      analytic ICI all-reduce cost added; marked "derived": true.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from inferno_tpu.models.profiles import PROFILES_DIR, build_profile_json
+
+
+def main() -> None:
+    raw_bf16 = json.loads((PROFILES_DIR / "raw/llama-3.1-8b_tpu.json").read_text())
+    raw_int8 = json.loads((PROFILES_DIR / "raw/llama-3.1-8b_tpu_int8.json").read_text())
+
+    outputs = {
+        # measured single-chip profiles
+        "llama-3.1-8b_v5e-1.json": build_profile_json(
+            raw_int8, "v5e-1", n_chips=1, weight_bytes_per_param=1.0
+        ),
+        "llama-3.1-8b_v5e-1-bf16.json": build_profile_json(
+            raw_bf16, "v5e-1", n_chips=1, weight_bytes_per_param=2.0
+        ),
+        # derived TP shapes: bf16 weights (fit at TP>=4) and int8 (w8a16,
+        # the standard TPU serving config — the autoscaler's usual pick)
+        "llama-3.1-8b_v5e-4.json": build_profile_json(
+            raw_bf16, "v5e-4", n_chips=4, weight_bytes_per_param=2.0
+        ),
+        "llama-3.1-8b_v5e-8.json": build_profile_json(
+            raw_bf16, "v5e-8", n_chips=8, weight_bytes_per_param=2.0
+        ),
+        "llama-3.1-8b_v5e-4-int8.json": build_profile_json(
+            raw_int8, "v5e-4-int8", n_chips=4, weight_bytes_per_param=1.0
+        ),
+        "llama-3.1-8b_v5e-8-int8.json": build_profile_json(
+            raw_int8, "v5e-8-int8", n_chips=8, weight_bytes_per_param=1.0
+        ),
+    }
+    for name, doc in outputs.items():
+        path = PROFILES_DIR / name
+        path.write_text(json.dumps(doc, indent=1) + "\n")
+        print(
+            f"{name}: alpha={doc['decodeParms']['alpha']} beta={doc['decodeParms']['beta']} "
+            f"gamma={doc['prefillParms']['gamma']} delta={doc['prefillParms']['delta']} "
+            f"maxBatch={doc['maxBatchSize']} derived={doc['derived']} "
+            f"r2={doc['fit']['decode_layer_linearity_r2']}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -51,12 +51,15 @@ from inferno_tpu.models.llama_block import (
     LlamaDims,
     init_stack,
     make_decode_fn,
+    make_mixed_fn,
     make_prefill_repeat_fn,
 )
 
 DECODE_BATCHES = [1, 2, 4, 8, 16, 24, 32, 48, 64, 96, 128]
 PREFILL_BATCHES = [1, 2, 4]
 PREFILL_TOKENS = [128, 256, 512, 1024, 2048]
+MIXED_BATCHES = [1, 8, 16, 32, 48]
+MIXED_TOKENS = [128, 512, 1024]
 LAYER_DEPTHS = [2, 4, 8]
 
 
@@ -86,13 +89,25 @@ def _timed_ms(call, iters: int, rtt_ms: float, inner: int) -> float:
     return max(statistics.median(ts) - rtt_ms, 0.0) / inner
 
 
-def profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out):
+def profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out, mixed_out, checkpoint, done):
+    needed = [("decode", n_layers, b, args.context) for b in args.decode_batches] + [
+        ("prefill", n_layers, b, t)
+        for b in args.prefill_batches for t in args.prefill_tokens
+    ] + [
+        ("mixed", n_layers, b, t, args.context)
+        for b in args.mixed_batches for t in args.mixed_tokens
+    ]
+    if all(k in done for k in needed):
+        print(f"depth L={n_layers}: fully measured, skipping init", flush=True)
+        return
     params = init_stack(jax.random.PRNGKey(n_layers), dims, n_layers, args.weight_dtype)
     jax.block_until_ready(params)
 
     steps = args.decode_steps
     decode = make_decode_fn(dims, n_layers, steps)
     for b in args.decode_batches:
+        if ("decode", n_layers, b, args.context) in done:
+            continue
         s_max = args.context + steps
         cache_gb = (
             n_layers * 2 * b * s_max * dims.kv_dim * 2 / 2**30
@@ -114,10 +129,38 @@ def profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out):
             {"n_layers": n_layers, "batch": b, "context": args.context, "step_ms": ms}
         )
         print(f"decode  L={n_layers:2d} B={b:3d} ctx={args.context}: {ms:8.3f} ms/step", flush=True)
+        checkpoint()
         del caches
+
+    msteps = max(4, args.decode_steps // 8)
+    mixed = make_mixed_fn(dims, n_layers, msteps)
+    for b in args.mixed_batches:
+        for t in args.mixed_tokens:
+            if ("mixed", n_layers, b, t, args.context) in done:
+                continue
+            s_max = args.context + msteps
+            caches = tuple(
+                jnp.zeros((b, dims.n_kv_heads, s_max, dims.head_dim), dtype=jnp.bfloat16)
+                for _ in range(2 * n_layers)
+            )
+            x = jnp.zeros((b, 1, dims.hidden), dtype=jnp.bfloat16)
+            chunk = jnp.ones((t, dims.hidden), dtype=jnp.bfloat16) * 0.01
+            ms = _timed_ms(
+                lambda: mixed(params, x, caches, chunk, jnp.int32(args.context))[0],
+                args.iters, rtt_ms, msteps,
+            )
+            mixed_out.append(
+                {"n_layers": n_layers, "batch": b, "in_tokens": t,
+                 "context": args.context, "step_ms": ms}
+            )
+            print(f"mixed   L={n_layers:2d} B={b:3d} T={t:5d}: {ms:8.3f} ms/step", flush=True)
+            checkpoint()
+            del caches
 
     for b in args.prefill_batches:
         for t in args.prefill_tokens:
+            if ("prefill", n_layers, b, t) in done:
+                continue
             # size the repeat count so device time ~ args.target_ms, one
             # compile per (shape, reps) with reps quantized to powers of 4
             est = 0.35 * n_layers * b * t / 512  # rough ms estimate to pick reps
@@ -131,6 +174,7 @@ def profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out):
                 {"n_layers": n_layers, "batch": b, "in_tokens": t, "reps": reps, "prefill_ms": ms}
             )
             print(f"prefill L={n_layers:2d} B={b:3d} T={t:5d} (x{reps}): {ms:8.3f} ms", flush=True)
+            checkpoint()
     del params
 
 
@@ -147,6 +191,10 @@ def main() -> None:
     ap.add_argument("--decode-batches", type=int, nargs="+", default=DECODE_BATCHES)
     ap.add_argument("--prefill-batches", type=int, nargs="+", default=PREFILL_BATCHES)
     ap.add_argument("--prefill-tokens", type=int, nargs="+", default=PREFILL_TOKENS)
+    ap.add_argument("--mixed-batches", type=int, nargs="+", default=MIXED_BATCHES)
+    ap.add_argument("--mixed-tokens", type=int, nargs="+", default=MIXED_TOKENS)
+    ap.add_argument("--resume", action="store_true",
+                    help="skip configs already present in --out (crash/tunnel-outage recovery)")
     args = ap.parse_args()
 
     dims = LlamaDims()
@@ -171,17 +219,43 @@ def main() -> None:
     }
     print(f"profiling on {dev.device_kind} ({dev.platform}); tunnel RTT {rtt_ms:.1f} ms", flush=True)
 
-    t0 = time.time()
-    decode_out, prefill_out = [], []
-    for n_layers in args.layer_depths:
-        profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out)
-    meta["wall_clock_s"] = round(time.time() - t0, 1)
-
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
-    out.write_text(json.dumps({"meta": meta, "decode": decode_out, "prefill": prefill_out}, indent=1))
-    print(f"wrote {out} ({len(decode_out)} decode + {len(prefill_out)} prefill samples, "
-          f"{meta['wall_clock_s']}s)", flush=True)
+    decode_out, prefill_out, mixed_out = [], [], []
+    done: set = set()
+    if args.resume and out.exists():
+        prev = json.loads(out.read_text())
+        decode_out = list(prev.get("decode", []))
+        prefill_out = list(prev.get("prefill", []))
+        mixed_out = list(prev.get("mixed", []))
+        done = {
+            ("decode", s["n_layers"], s["batch"], s.get("context", args.context))
+            for s in decode_out
+        } | {
+            ("prefill", s["n_layers"], s["batch"], s["in_tokens"]) for s in prefill_out
+        } | {
+            ("mixed", s["n_layers"], s["batch"], s["in_tokens"], s.get("context", args.context))
+            for s in mixed_out
+        }
+        meta = {**prev.get("meta", {}), **meta}
+        print(f"resuming: {len(done)} configs already measured", flush=True)
+
+    t0 = time.time()
+
+    def checkpoint() -> None:
+        # write-through after every sample: a tunnel outage or crash loses
+        # at most the in-flight config, and --resume picks up from here
+        out.write_text(
+            json.dumps({"meta": meta, "decode": decode_out,
+                        "prefill": prefill_out, "mixed": mixed_out}, indent=1)
+        )
+
+    for n_layers in args.layer_depths:
+        profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out, mixed_out, checkpoint, done)
+    meta["wall_clock_s"] = round(time.time() - t0, 1) + (meta.get("wall_clock_s") or 0)
+    checkpoint()
+    print(f"wrote {out} ({len(decode_out)} decode + {len(prefill_out)} prefill + "
+          f"{len(mixed_out)} mixed samples, {meta['wall_clock_s']}s)", flush=True)
 
 
 if __name__ == "__main__":
