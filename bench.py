@@ -75,7 +75,7 @@ P99_MARGIN = slo_margin_for(0.99)
 
 # baseline methodology workload (parameter-estimation.md: 128 in / 128 out)
 REQ = RequestSize(avg_in_tokens=128, avg_out_tokens=128)
-ARRIVAL_RPS = 100.0  # fleet-scale offered load
+ARRIVAL_RPS = 1000.0  # fleet-scale offered load (north star: a v5e-64-scale pool)
 
 # public on-demand list prices, USD/hr
 V5E_CHIP_HR = 1.20

@@ -82,6 +82,9 @@ def init_stack(
         full = jax.random.normal(k, shape, dtype=jnp.float32) * scale
         if weight_dtype == "int8":
             return jnp.clip(jnp.round(full / scale * 63.0), -127, 127).astype(jnp.int8)
+        if weight_dtype == "float32":
+            # CPU-testable mode: the CPU dot thunk lacks bf16 support
+            return full
         return full.astype(bf)
 
     layers = {
@@ -261,7 +264,7 @@ def make_mixed_fn(dims: LlamaDims, n_layers: int, n_steps: int):
             new_caches.extend([k_c, v_c])
         x_all = _rmsnorm(x_all, params["norm_out"])
         logits = _mm(x_all, params["lm_head"])  # decode rows + chunk tail all sampled
-        nxt = jnp.tanh(logits[:b, : dims.hidden]).astype(jnp.bfloat16)[:, None, :]
+        nxt = jnp.tanh(logits[:b, : dims.hidden]).astype(x_dec.dtype)[:, None, :]
         return nxt, tuple(new_caches), jnp.sum(logits.astype(jnp.float32))
 
     def mixed(params, x_dec, caches, chunk, start_pos):
@@ -357,7 +360,7 @@ def make_decode_fn(dims: LlamaDims, n_layers: int, n_steps: int):
         # engine samples over the full vocab, so the caller must consume a
         # reduction of ALL logits or XLA slices the head matmul down to the
         # first `hidden` columns (observed: 40% of decode traffic DCE'd)
-        nxt = jnp.tanh(logits[:, : dims.hidden]).astype(jnp.bfloat16)[:, None, :]
+        nxt = jnp.tanh(logits[:, : dims.hidden]).astype(x.dtype)[:, None, :]
         return nxt, caches, jnp.sum(logits.astype(jnp.float32))
 
     def decode(params, x, caches, start_pos):

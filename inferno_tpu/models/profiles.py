@@ -34,7 +34,7 @@ from typing import Any, Mapping
 
 import numpy as np
 
-from inferno_tpu.config.types import ModelPerfSpec
+from inferno_tpu.config.types import DecodeParms, ModelPerfSpec, PrefillParms
 from inferno_tpu.models.linear import FittedProfile, fit_profile
 from inferno_tpu.models.llama_block import LlamaDims
 
@@ -98,39 +98,150 @@ def synthesize_full_model(raw: Mapping[str, Any], n_layers_full: int = 32):
     return decode, prefill, meta
 
 
-def fit_tpu_profile(raw: Mapping[str, Any], n_layers_full: int = 32):
-    """FittedProfile + synthesis metadata from a raw measurement file.
+# Anchor prompt length for TTFT calibration: the reference's two-point
+# method bakes its measurement prompt length (128 tokens) into gamma/delta
+# the same way (parameter-estimation.md: TTFT measured at in=128 for B=1
+# and B=64). Longer-prompt regimes belong in context-bucketed profiles.
+TTFT_ANCHOR_TOKENS = 128
 
-    TTFT (gamma/delta) calibration prefers the `mixed` sweep — per-step
-    time of a continuous-batching iteration (decode batch + one prefill
-    chunk sharing the weight pass, llama_block.make_mixed_fn). That is the
-    quantity the reference's guidellm methodology actually observes for
-    TTFT-vs-concurrency (parameter-estimation.md:241-266: TTFT at B=64 is
-    ~one request's chunk riding a shared iteration, NOT 64 serialized
-    prefills), so fitting delta from full-batch prefill times would
+
+def _allreduce_per_token_ms(n_chips: int, hidden: int, ici_bw_gbs: float) -> float:
+    """Ring all-reduce cost per token activation (bf16) per layer-pair,
+    msec -- shared by the parm-level decode derivation and the point-level
+    TTFT scaling so the ICI model cannot diverge between them."""
+    return 2.0 * (n_chips - 1) / n_chips * hidden * 2 / (ici_bw_gbs * 1e9) * 1e3
+
+
+def ttft_points(raw: Mapping[str, Any], n_layers_full: int = 32, decode_pts=None):
+    """Full-model TTFT calibration points [(batch, in_tokens, ttft_ms)].
+
+    TTFT (gamma/delta) calibration targets the latency of ONE
+    continuous-batching iteration carrying the arriving request's prefill
+    chunk -- the quantity the reference's guidellm methodology actually
+    observes (parameter-estimation.md:241-266: TTFT at B=64 is one
+    request's chunk riding a shared iteration, NOT 64 serialized
+    prefills). Fitting delta from full-batch prefill times would
     overstate the TPU's TTFT response ~B-fold relative to how the A100
-    baseline's delta was derived. Raw files without a mixed sweep fall
-    back to the full-batch prefill samples (conservative)."""
-    decode, prefill, meta = synthesize_full_model(raw, n_layers_full)
+    baseline's delta was derived. Preference order:
+
+    1. the `mixed` sweep (llama_block.make_mixed_fn, measured on-chip);
+    2. synthesized upper bound decode(B) + prefill(1, T) from the two
+       measured sweeps -- assumes NO weight-read sharing between the
+       decode rows and the chunk, so it is strictly pessimistic.
+
+    `decode_pts`: already-extrapolated full-model decode points (from
+    synthesize_full_model) to avoid re-running the layer regression.
+    """
     if raw.get("mixed"):
-        ttft_pts, m_r2 = _extrapolate_layers(
+        pts, r2 = _extrapolate_layers(
             list(raw["mixed"]), "step_ms", ("batch", "in_tokens"), n_layers_full
         )
-        meta["ttft_calibration"] = "mixed-step"
-        meta["mixed_layer_linearity_r2"] = round(m_r2, 5)
-        prefill = [
-            {"batch": p["batch"], "in_tokens": p["in_tokens"], "prefill_ms": p["step_ms"]}
-            for p in ttft_pts
-        ]
-    else:
-        meta["ttft_calibration"] = "full-batch-prefill"
-    fitted = fit_profile(
-        decode_batch=np.array([p["batch"] for p in decode]),
-        decode_itl_ms=np.array([p["step_ms"] for p in decode]),
-        prefill_batch=np.array([p["batch"] for p in prefill]),
-        prefill_in_tokens=np.array([p["in_tokens"] for p in prefill]),
-        prefill_ms=np.array([p["prefill_ms"] for p in prefill]),
+        return (
+            [(p["batch"], p["in_tokens"], p["step_ms"]) for p in pts],
+            {"ttft_calibration": "mixed-step", "mixed_layer_linearity_r2": round(r2, 5)},
+        )
+    if decode_pts is None:
+        decode_pts, _ = _extrapolate_layers(
+            list(raw["decode"]), "step_ms", ("batch",), n_layers_full
+        )
+    b1_prefill = [p for p in raw["prefill"] if p["batch"] == 1]
+    if not b1_prefill:
+        raise ValueError(
+            "TTFT calibration without a mixed sweep needs batch=1 prefill "
+            "samples to synthesize the decode(B) + prefill(1,T) upper "
+            "bound; re-run tools/profile_tpu.py with 1 in --prefill-batches "
+            "(or with the mixed sweep enabled)"
+        )
+    prefill, _ = _extrapolate_layers(
+        b1_prefill, "prefill_ms", ("batch", "in_tokens"), n_layers_full
     )
+    out = [
+        (d["batch"], p["in_tokens"], d["step_ms"] + p["prefill_ms"])
+        for d in decode_pts
+        for p in prefill
+    ]
+    return out, {"ttft_calibration": "mixed-upper-bound(decode+prefill)"}
+
+
+def _tp_scale_ttft_points(
+    points, n_chips: int, n_layers: int,
+    hidden: int, ici_bw_gbs: float, ici_latency_us: float,
+):
+    """Apply tensor parallelism at the point level: per-chip compute
+    divides; each layer's two ring all-reduces carry (B + T) token
+    activations (every row of the shared iteration) plus hop latency."""
+    if n_chips <= 1:
+        return points
+    per_tok_ms = 2 * n_layers * _allreduce_per_token_ms(n_chips, hidden, ici_bw_gbs)
+    lat_ms = 2 * n_layers * 2.0 * (n_chips - 1) * ici_latency_us * 1e-3
+    return [
+        (b, t, ms / n_chips + per_tok_ms * (b + t) + lat_ms) for b, t, ms in points
+    ]
+
+
+def _fit_ttft_anchor(points, anchor_tokens: int = TTFT_ANCHOR_TOKENS):
+    """gamma/delta the reference way: the TTFT-vs-B line at the anchor
+    prompt length (delta = slope / anchor). The iteration surface is
+    additive in (B, T), so a naive product-form fit over the whole grid
+    inflates gamma several-fold at low load; anchoring reproduces the
+    reference's own two-point procedure exactly, with more points."""
+    from inferno_tpu.models.linear import _fit_line
+
+    at_anchor = sorted((b, ms) for b, t, ms in points if t == anchor_tokens)
+    if len(at_anchor) < 2:
+        # grid did not include the anchor length: product-form fallback
+        x = np.array([b * t for b, t, _ in points], dtype=np.float64)
+        y = np.array([ms for _, _, ms in points], dtype=np.float64)
+        gamma, delta, rmse = _fit_line(x, y)
+        return PrefillParms(gamma=gamma, delta=delta), rmse, "product-form"
+    bs = np.array([b for b, _ in at_anchor], dtype=np.float64)
+    ys = np.array([ms for _, ms in at_anchor], dtype=np.float64)
+    gamma, slope, rmse = _fit_line(bs, ys)
+    return (
+        PrefillParms(gamma=gamma, delta=slope / anchor_tokens),
+        rmse,
+        f"anchored@{anchor_tokens}tok",
+    )
+
+
+def fit_tpu_profile(
+    raw: Mapping[str, Any], n_layers_full: int = 32, n_chips: int = 1,
+    ici_bw_gbs: float = 45.0, ici_latency_us: float = 1.0,
+):
+    """FittedProfile + synthesis metadata from a raw measurement file.
+    `n_chips` > 1 derives a tensor-parallel profile: decode parms via
+    derive_tensor_parallel, TTFT points TP-scaled before fitting."""
+    from inferno_tpu.models.linear import _fit_line
+
+    decode, _, meta = synthesize_full_model(raw, n_layers_full)
+    points, ttft_meta = ttft_points(raw, n_layers_full, decode_pts=decode)
+    meta.update(ttft_meta)
+    dims_hidden = int(raw["meta"]["dims"]["hidden"])
+    points = _tp_scale_ttft_points(
+        points, n_chips, n_layers_full, dims_hidden, ici_bw_gbs, ici_latency_us
+    )
+    d_b = np.array([p["batch"] for p in decode], dtype=np.float64)
+    d_y = np.array([p["step_ms"] for p in decode], dtype=np.float64)
+    alpha, beta, d_rmse = _fit_line(d_b, d_y)
+    prefill_parms, p_rmse, fit_kind = _fit_ttft_anchor(points)
+    meta["ttft_fit"] = fit_kind
+    fitted = FittedProfile(
+        decode=DecodeParms(alpha=alpha, beta=beta),
+        prefill=prefill_parms,
+        decode_rmse=d_rmse,
+        prefill_rmse=p_rmse,
+    )
+    if n_chips > 1:
+        tp = derive_tensor_parallel(
+            fitted, n_chips, n_layers=n_layers_full, hidden=dims_hidden,
+            ici_bw_gbs=ici_bw_gbs, ici_latency_us=ici_latency_us,
+        )
+        # decode parms from the parm-level derivation; prefill parms stay
+        # from the point-level TP fit above (physically per-iteration)
+        fitted = FittedProfile(
+            decode=tp.decode, prefill=fitted.prefill,
+            decode_rmse=fitted.decode_rmse, prefill_rmse=fitted.prefill_rmse,
+        )
     return fitted, meta
 
 
@@ -222,10 +333,8 @@ def build_profile_json(
     n_layers_full = dims_in.pop("n_layers_full")
     dims_in["n_layers"] = n_layers_full
     dims = LlamaDims(**dims_in)
-    fitted, synth_meta = fit_tpu_profile(raw, n_layers_full)
+    fitted, synth_meta = fit_tpu_profile(raw, n_layers_full, n_chips=n_chips)
     derived = n_chips > 1
-    if derived:
-        fitted = derive_tensor_parallel(fitted, n_chips, n_layers=n_layers_full, hidden=dims.hidden)
     max_batch = max_batch_from_memory(
         dims, hbm_per_chip_gb, at_tokens,
         weight_bytes_per_param=weight_bytes_per_param, n_chips=n_chips,

@@ -33,10 +33,13 @@ TRUE_LAYER_MS = 0.6
 TRUE_HEAD_MS = 1.5
 TRUE_BETA_PER_LAYER = 0.004
 TRUE_PREFILL_PER_LAYER_PER_TOK = 0.003
+# mixed-step ground truth: exactly the reference functional form
+# gamma + delta * T * B with per-layer slope Q
+TRUE_MIXED_Q = 0.00002
 
 
-def fake_raw():
-    decode, prefill = [], []
+def fake_raw(mixed: bool = False):
+    decode, prefill, mixed_pts = [], [], []
     for n_layers in (2, 4, 8):
         for b in (1, 8, 32, 64):
             step = TRUE_HEAD_MS + n_layers * (TRUE_LAYER_MS + TRUE_BETA_PER_LAYER * b)
@@ -49,7 +52,16 @@ def fake_raw():
                 prefill.append(
                     {"n_layers": n_layers, "batch": b, "in_tokens": t, "prefill_ms": ms}
                 )
+        if mixed:
+            for b in (1, 8, 32):
+                for t in (128, 512, 1024):
+                    ms = TRUE_HEAD_MS + n_layers * (TRUE_LAYER_MS + TRUE_MIXED_Q * b * t)
+                    mixed_pts.append(
+                        {"n_layers": n_layers, "batch": b, "in_tokens": t,
+                         "context": 1024, "step_ms": ms}
+                    )
     return {
+        **({"mixed": mixed_pts} if mixed else {}),
         "meta": {
             "model": "llama-3.1-8b",
             "dims": {
@@ -71,12 +83,29 @@ def test_layer_extrapolation_recovers_ground_truth():
     assert by_batch[1] == pytest.approx(expected_b1, rel=1e-6)
 
 
-def test_fit_recovers_linear_parms():
-    fitted, _ = fit_tpu_profile(fake_raw())
+def test_fit_recovers_linear_parms_from_mixed_sweep():
+    fitted, meta = fit_tpu_profile(fake_raw(mixed=True))
+    assert meta["ttft_calibration"] == "mixed-step"
     assert fitted.decode.alpha == pytest.approx(TRUE_HEAD_MS + 32 * TRUE_LAYER_MS, rel=1e-6)
     assert fitted.decode.beta == pytest.approx(32 * TRUE_BETA_PER_LAYER, rel=1e-6)
-    assert fitted.prefill.delta == pytest.approx(32 * TRUE_PREFILL_PER_LAYER_PER_TOK, rel=1e-6)
+    # mixed-step TTFT calibration recovers the per-(token*batch) slope
+    assert fitted.prefill.delta == pytest.approx(32 * TRUE_MIXED_Q, rel=1e-6)
     assert fitted.decode_rmse < 1e-6
+
+
+def test_fit_without_mixed_uses_upper_bound():
+    """No mixed sweep: TTFT points are synthesized as decode(B) +
+    prefill(1, T) — strictly above either component, never the B-fold
+    full-batch-prefill overstatement."""
+    fitted, meta = fit_tpu_profile(fake_raw())
+    assert meta["ttft_calibration"].startswith("mixed-upper-bound")
+    # at (B=64, T=2048) the fitted TTFT must sit near decode(64) +
+    # prefill(1, 2048), far below 64 serialized prefills
+    pred = fitted.prefill.gamma + fitted.prefill.delta * 2048 * 64
+    true_decode = TRUE_HEAD_MS + 32 * (TRUE_LAYER_MS + TRUE_BETA_PER_LAYER * 64)
+    true_chunk = TRUE_HEAD_MS + 32 * TRUE_PREFILL_PER_LAYER_PER_TOK * 2048
+    assert pred < 3 * (true_decode + true_chunk)
+    assert fitted.prefill.delta < TRUE_PREFILL_PER_LAYER_PER_TOK * 32
 
 
 def test_extrapolation_rejects_single_depth():
