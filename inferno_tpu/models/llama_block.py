@@ -61,6 +61,22 @@ class LlamaDims:
         return layers * 2 * self.kv_dim * dtype_bytes
 
 
+# Profiling presets for the dense-decoder families the profiler supports
+# out of the box; any other architecture is a LlamaDims(...) away.
+MODEL_PRESETS: dict[str, LlamaDims] = {
+    "llama-3.1-8b": LlamaDims(),
+    "llama-3.2-3b": LlamaDims(hidden=3072, n_heads=24, n_kv_heads=8,
+                              head_dim=128, ffn=8192, vocab=128256,
+                              n_layers=28),
+    "llama-3.2-1b": LlamaDims(hidden=2048, n_heads=32, n_kv_heads=8,
+                              head_dim=64, ffn=8192, vocab=128256,
+                              n_layers=16),
+}
+# NOTE: presets are Llama-family only on purpose — architectures with a
+# different layer body (Gemma-2's post-norms/softcaps/sliding-window,
+# MoE models) need their own block to be measured honestly.
+
+
 def init_stack(
     key: jax.Array, dims: LlamaDims, n_layers: int, weight_dtype: str = "bfloat16"
 ) -> dict:

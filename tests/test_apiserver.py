@@ -157,6 +157,52 @@ def test_configmaps_and_nodes(server, client):
     assert nodes and nodes[0]["metadata"]["name"] == "tpu-node-1"
 
 
+def test_http_error_mapping(server, client):
+    """RestKubeClient maps the API server's failure statuses to the typed
+    errors the reconciler's skip/backoff logic branches on: 404 ->
+    NotFound, 409 -> Conflict, anything else -> KubeError with the
+    status body in the message."""
+    from inferno_tpu.controller.kube import KubeError
+
+    with pytest.raises(NotFound):
+        client.get_deployment(NS, "missing")
+
+    # 422 (schema rejection) surfaces as a KubeError carrying the reason;
+    # a status write violating the committed CRD must not be silent
+    post(server, f"/apis/llmd.ai/v1alpha1/namespaces/{NS}/variantautoscalings",
+         make_va_doc(name="emap"))
+    va = client.get_variant_autoscaling(NS, "emap")
+    va.status.desired_optimized_alloc.num_replicas = 3
+    bad = {
+        "apiVersion": "llmd.ai/v1alpha1", "kind": "VariantAutoscaling",
+        "metadata": {"name": "emap", "namespace": NS},
+        "status": {"desiredOptimizedAlloc": {"numReplicas": "three"}},
+    }
+    req = urllib.request.Request(
+        server.url + f"/apis/llmd.ai/v1alpha1/namespaces/{NS}/variantautoscalings/emap/status",
+        method="PATCH", data=json.dumps(bad).encode(),
+        headers={"Content-Type": "application/merge-patch+json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(req)
+    assert err.value.code == 422
+
+    # the same write through the client maps to KubeError (not swallowed)
+    va.status.desired_optimized_alloc.num_replicas = "three"  # type: ignore
+    with pytest.raises(KubeError):
+        client.update_variant_autoscaling_status(va)
+
+
+def test_list_resource_version_stable_without_writes(server, client):
+    post(server, f"/api/v1/namespaces/{CFG_NS}/configmaps", {
+        "metadata": {"name": "rv-probe", "namespace": CFG_NS}, "data": {"a": "1"},
+    })
+    req = urllib.request.Request(server.url + f"/api/v1/namespaces/{CFG_NS}/configmaps")
+    rv1 = json.loads(urllib.request.urlopen(req).read())["metadata"]["resourceVersion"]
+    rv2 = json.loads(urllib.request.urlopen(req).read())["metadata"]["resourceVersion"]
+    assert rv1 == rv2  # a LIST must not consume resourceVersions
+
+
 # -- leases / leader election -------------------------------------------------
 
 

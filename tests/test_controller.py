@@ -379,3 +379,69 @@ def test_current_alloc_max_batch_last_resort_constant():
         accelerator="unknown-shape",
     )
     assert got == DEFAULT_MAX_BATCH
+
+
+def test_disaggregated_variant_through_full_cycle_all_backends():
+    """A JetStream-style disaggregated VA (separate prefill/decode engines,
+    atomic replica units) flows through the whole reconcile loop — CR
+    profile -> tandem sizing -> solver -> status — and every compute
+    backend reaches the same decision (the tandem kernel path previously
+    had only analyzer/fleet-level coverage)."""
+    from inferno_tpu.config.types import DisaggSpec
+
+    decisions = {}
+    for backend in ("scalar", "tpu", "native"):
+        cluster = InMemoryCluster()
+        cluster.set_configmap(CFG_NS, "accelerator-unit-costs", {
+            "v5e-4": json.dumps({"cost": 10.0}),
+            "v5e-16": json.dumps({"cost": 10.0}),
+        })
+        cluster.set_configmap(CFG_NS, "service-classes-config", {
+            "premium.yaml": (
+                "name: Premium\npriority: 1\ndata:\n"
+                f"  - model: {MODEL}\n    slo-ttft: 500\n    slo-tpot: 24\n"
+            ),
+        })
+        cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {})
+        va = VariantAutoscaling(
+            name="llama-disagg", namespace=NS,
+            labels={ACCELERATOR_LABEL: "v5e-4"},
+            spec=VariantAutoscalingSpec(
+                model_id=MODEL,
+                slo_class_ref=ConfigMapKeyRef(name="service-classes-config", key="Premium"),
+                accelerators=[
+                    AcceleratorProfile(
+                        acc="v5e-4", acc_count=1, max_batch_size=64, at_tokens=128,
+                        decode_parms=DecodeParms(alpha=18.0, beta=0.3),
+                        prefill_parms=PrefillParms(gamma=5.0, delta=0.02),
+                        disagg=DisaggSpec(prefill_slices=1, decode_slices=2,
+                                          prefill_max_batch=8),
+                    ),
+                    # an aggregated candidate shape alongside the disagg one,
+                    # so the "native" leg actually routes lanes through the
+                    # C++ solver (tandem lanes always ride the XLA kernel)
+                    AcceleratorProfile(
+                        acc="v5e-16", acc_count=1, max_batch_size=128, at_tokens=128,
+                        decode_parms=DecodeParms(alpha=12.0, beta=0.25),
+                        prefill_parms=PrefillParms(gamma=4.0, delta=0.012),
+                    ),
+                ],
+            ),
+        )
+        cluster.add_variant_autoscaling(va)
+        cluster.add_deployment(NS, "llama-disagg", replicas=1)
+        rec = reconciler(cluster, make_prom(arrival_rps=30.0), )
+        rec.config.compute_backend = backend
+        report = rec.run_cycle()
+        assert report.errors == [], (backend, report.errors)
+        va = cluster.get_variant_autoscaling(NS, "llama-disagg")
+        cond = va.status.condition(TYPE_OPTIMIZATION_READY)
+        assert cond is not None and cond.status == "True", (backend, cond)
+        decisions[backend] = (
+            va.status.desired_optimized_alloc.num_replicas,
+            va.status.desired_optimized_alloc.accelerator,
+        )
+    assert len(set(decisions.values())) == 1, decisions
+    replicas, acc = decisions["scalar"]
+    assert acc == "v5e-4"
+    assert replicas >= 1

@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from inferno_tpu.models.llama_block import (
+    MODEL_PRESETS,
     LlamaDims,
     init_stack,
     make_decode_fn,
@@ -180,7 +181,9 @@ def profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out, mixed_o
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="profiles/raw/llama-3.1-8b_tpu.json")
+    ap.add_argument("--out", default="",
+                    help="output JSON; default profiles/raw/<model>_tpu[_<dtype>].json")
+    ap.add_argument("--model", choices=sorted(MODEL_PRESETS), default="llama-3.1-8b")
     ap.add_argument("--iters", type=int, default=7)
     ap.add_argument("--weight-dtype", choices=["bfloat16", "int8"], default="bfloat16")
     ap.add_argument("--decode-steps", type=int, default=64)
@@ -197,11 +200,11 @@ def main() -> None:
                     help="skip configs already present in --out (crash/tunnel-outage recovery)")
     args = ap.parse_args()
 
-    dims = LlamaDims()
+    dims = MODEL_PRESETS[args.model]
     dev = jax.devices()[0]
     rtt_ms = measure_rtt()
     meta = {
-        "model": "llama-3.1-8b",
+        "model": args.model,
         "dims": {
             "hidden": dims.hidden, "n_heads": dims.n_heads,
             "n_kv_heads": dims.n_kv_heads, "head_dim": dims.head_dim,
@@ -219,12 +222,22 @@ def main() -> None:
     }
     print(f"profiling on {dev.device_kind} ({dev.platform}); tunnel RTT {rtt_ms:.1f} ms", flush=True)
 
+    if not args.out:
+        suffix = "" if args.weight_dtype == "bfloat16" else f"_{args.weight_dtype}"
+        args.out = f"profiles/raw/{args.model}_tpu{suffix}.json"
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     decode_out, prefill_out, mixed_out = [], [], []
     done: set = set()
     if args.resume and out.exists():
         prev = json.loads(out.read_text())
+        prev_model = (prev.get("meta") or {}).get("model")
+        if prev_model and prev_model != args.model:
+            raise SystemExit(
+                f"refusing --resume: {out} holds measurements for "
+                f"{prev_model!r}, not {args.model!r} — cross-model timings "
+                "must never mix in one raw file"
+            )
         decode_out = list(prev.get("decode", []))
         prefill_out = list(prev.get("prefill", []))
         mixed_out = list(prev.get("mixed", []))
