@@ -292,7 +292,13 @@ def fleet_cycle_metrics(full: bool = True) -> dict:
     except Exception:
         native_ms = None
 
+    import jax
+
     out = {
+        # which platform the jitted fleet path actually ran on: the batched
+        # XLA program is designed for TPU (r02 measured ~100 ms there); on
+        # a CPU fallback the C++ backend is the intended fast path
+        "platform": jax.default_backend(),
         "lanes_512": {
             "tpu_ms": round(tpu_ms, 3),
             "scalar_ms": round(scalar_ms, 3),
@@ -318,11 +324,38 @@ def fleet_cycle_metrics(full: bool = True) -> dict:
     return out
 
 
+def _pin_cpu_if_tpu_unreachable(timeout_s: float = 120.0) -> None:
+    """The TPU on this box sits behind a network tunnel that can be down
+    for hours; jax backend init then hangs forever instead of failing.
+    Probe device initialization in a subprocess with a timeout and pin
+    the CPU platform for this process when the probe dies, so the bench
+    always produces its JSON line (fleet-cycle timings are then CPU
+    numbers; the north-star metric never needed a device)."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        probe = subprocess.run(
+            [_sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_s,
+        )
+        if probe.returncode == 0:
+            return
+    except subprocess.TimeoutExpired:
+        pass
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print("# TPU unreachable; fleet-cycle timings measured on CPU",
+          file=_sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="skip the 4096-lane scaling row (CI smoke)")
     args = ap.parse_args()
+    _pin_cpu_if_tpu_unreachable()
     ns = north_star()
     cycles = fleet_cycle_metrics(full=not args.quick)
     print(

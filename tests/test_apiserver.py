@@ -172,7 +172,6 @@ def test_http_error_mapping(server, client):
     post(server, f"/apis/llmd.ai/v1alpha1/namespaces/{NS}/variantautoscalings",
          make_va_doc(name="emap"))
     va = client.get_variant_autoscaling(NS, "emap")
-    va.status.desired_optimized_alloc.num_replicas = 3
     bad = {
         "apiVersion": "llmd.ai/v1alpha1", "kind": "VariantAutoscaling",
         "metadata": {"name": "emap", "namespace": NS},

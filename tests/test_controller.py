@@ -417,9 +417,22 @@ def test_disaggregated_variant_through_full_cycle_all_backends():
                         disagg=DisaggSpec(prefill_slices=1, decode_slices=2,
                                           prefill_max_batch=8),
                     ),
-                    # an aggregated candidate shape alongside the disagg one,
-                    # so the "native" leg actually routes lanes through the
-                    # C++ solver (tandem lanes always ride the XLA kernel)
+                ],
+            ),
+        )
+        cluster.add_variant_autoscaling(va)
+        cluster.add_deployment(NS, "llama-disagg", replicas=1)
+        # a second, aggregated-only variant whose CURRENT shape is v5e-16:
+        # keep_accelerator pins candidates to the running shape, so this is
+        # the variant whose lane genuinely routes through the C++ solver in
+        # the "native" leg (tandem lanes always ride the XLA kernel)
+        agg = VariantAutoscaling(
+            name="llama-agg", namespace=NS,
+            labels={ACCELERATOR_LABEL: "v5e-16"},
+            spec=VariantAutoscalingSpec(
+                model_id=MODEL,
+                slo_class_ref=ConfigMapKeyRef(name="service-classes-config", key="Premium"),
+                accelerators=[
                     AcceleratorProfile(
                         acc="v5e-16", acc_count=1, max_batch_size=128, at_tokens=128,
                         decode_parms=DecodeParms(alpha=12.0, beta=0.25),
@@ -428,20 +441,25 @@ def test_disaggregated_variant_through_full_cycle_all_backends():
                 ],
             ),
         )
-        cluster.add_variant_autoscaling(va)
-        cluster.add_deployment(NS, "llama-disagg", replicas=1)
+        cluster.add_variant_autoscaling(agg)
+        cluster.add_deployment(NS, "llama-agg", replicas=1)
+
         rec = reconciler(cluster, make_prom(arrival_rps=30.0), )
         rec.config.compute_backend = backend
         report = rec.run_cycle()
         assert report.errors == [], (backend, report.errors)
-        va = cluster.get_variant_autoscaling(NS, "llama-disagg")
-        cond = va.status.condition(TYPE_OPTIMIZATION_READY)
-        assert cond is not None and cond.status == "True", (backend, cond)
-        decisions[backend] = (
-            va.status.desired_optimized_alloc.num_replicas,
-            va.status.desired_optimized_alloc.accelerator,
-        )
+        got = []
+        for name in ("llama-disagg", "llama-agg"):
+            va = cluster.get_variant_autoscaling(NS, name)
+            cond = va.status.condition(TYPE_OPTIMIZATION_READY)
+            assert cond is not None and cond.status == "True", (backend, name, cond)
+            got.append((
+                name,
+                va.status.desired_optimized_alloc.num_replicas,
+                va.status.desired_optimized_alloc.accelerator,
+            ))
+        decisions[backend] = tuple(got)
     assert len(set(decisions.values())) == 1, decisions
-    replicas, acc = decisions["scalar"]
-    assert acc == "v5e-4"
-    assert replicas >= 1
+    (_, d_replicas, d_acc), (_, a_replicas, a_acc) = decisions["scalar"]
+    assert d_acc == "v5e-4" and a_acc == "v5e-16"
+    assert d_replicas >= 1 and a_replicas >= 1

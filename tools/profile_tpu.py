@@ -231,13 +231,15 @@ def main() -> None:
     done: set = set()
     if args.resume and out.exists():
         prev = json.loads(out.read_text())
-        prev_model = (prev.get("meta") or {}).get("model")
-        if prev_model and prev_model != args.model:
-            raise SystemExit(
-                f"refusing --resume: {out} holds measurements for "
-                f"{prev_model!r}, not {args.model!r} — cross-model timings "
-                "must never mix in one raw file"
-            )
+        prev_meta = prev.get("meta") or {}
+        for key, want in (("model", args.model), ("weight_dtype", args.weight_dtype)):
+            have = prev_meta.get(key)
+            if have and have != want:
+                raise SystemExit(
+                    f"refusing --resume: {out} holds {key}={have!r} "
+                    f"measurements, not {want!r} — mixed timings in one raw "
+                    "file would silently corrupt the downstream fits"
+                )
         decode_out = list(prev.get("decode", []))
         prefill_out = list(prev.get("prefill", []))
         mixed_out = list(prev.get("mixed", []))
