@@ -72,6 +72,29 @@ def make_va_doc(name="llama-premium", model="meta/llama-3.1-8b"):
     }
 
 
+def seed_cluster(server, interval="30s"):
+    """Seed the three controller ConfigMaps, one VA, and its Deployment —
+    the minimal reconcilable cluster, shared by the cycle/process tests."""
+    for path, body in [
+        (f"/api/v1/namespaces/{CFG_NS}/configmaps",
+         {"metadata": {"name": "accelerator-unit-costs", "namespace": CFG_NS},
+          "data": {"v5e-4": json.dumps({"cost": 10.0})}}),
+        (f"/api/v1/namespaces/{CFG_NS}/configmaps",
+         {"metadata": {"name": "service-classes-config", "namespace": CFG_NS},
+          "data": {"premium.yaml": (
+              "name: Premium\npriority: 1\ndata:\n"
+              "  - model: meta/llama-3.1-8b\n    slo-ttft: 500\n    slo-tpot: 24\n"
+          )}}),
+        (f"/api/v1/namespaces/{CFG_NS}/configmaps",
+         {"metadata": {"name": "inferno-autoscaler-config", "namespace": CFG_NS},
+          "data": {"GLOBAL_OPT_INTERVAL": interval}}),
+        (f"/apis/llmd.ai/v1alpha1/namespaces/{NS}/variantautoscalings",
+         make_va_doc()),
+    ]:
+        post(server, path, body)
+    add_deployment(server, NS, "llama-premium", replicas=1)
+
+
 def add_deployment(server, ns, name, replicas=1):
     post(server, f"/apis/apps/v1/namespaces/{ns}/deployments", {
         "metadata": {"name": name, "namespace": ns},
@@ -273,6 +296,98 @@ def test_watch_stream_wakes_and_survives_410(server, client):
         watcher.stop()
 
 
+def test_two_instance_process_shape_with_failover(server):
+    """The full process shape of main(): two controller instances, each
+    with its own RestKubeClient, lease elector, watcher, and run_forever
+    loop against the HTTP API server. Exactly one reconciles at a time;
+    when the leader releases, the follower takes over and keeps writing
+    fresh decisions. (The reference delegates this to controller-runtime's
+    manager; here it is this repo's own leader.py/watch.py/run_forever.)"""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_controller import make_prom
+
+    from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+
+    seed_cluster(server, interval="1s")
+
+    instances = []
+    stops = []
+    try:
+        for ident in ("inst-a", "inst-b"):
+            kube = RestKubeClient(base_url=server.url, token="", namespace=CFG_NS)
+            rec = Reconciler(
+                kube=kube, prom=make_prom(arrival_rps=40.0),
+                config=ReconcilerConfig(config_namespace=CFG_NS,
+                                        compute_backend="scalar"),
+            )
+            elector = LeaderElector(kube=kube, identity=ident, namespace=CFG_NS,
+                                    lease_duration=1.0, renew_deadline=0.8,
+                                    retry_period=0.1)
+            elector.start()
+            watcher = Watcher(kube, rec.poke, config_namespace=CFG_NS)
+            watcher.start()
+            stop = {"stop": False}
+            t = threading.Thread(
+                target=rec.run_forever,
+                kwargs={"stop_check": lambda s=stop: s["stop"],
+                        "gate": elector.is_leader},
+                daemon=True,
+            )
+            t.start()
+            instances.append((rec, elector, watcher, t))
+            stops.append(stop)
+
+        def wait_for(pred, timeout=15.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return True
+                time.sleep(0.1)
+            return False
+
+        client = RestKubeClient(base_url=server.url, token="", namespace=CFG_NS)
+
+        def decided():
+            va = client.get_variant_autoscaling(NS, "llama-premium")
+            return va.status.desired_optimized_alloc.num_replicas > 1
+
+        assert wait_for(decided), "no instance ever produced a decision"
+        leaders = [e.is_leader() for _, e, _, _ in instances]
+        assert sum(leaders) == 1, f"leadership not exclusive: {leaders}"
+        first_leader = leaders.index(True)
+
+        # leader steps down (releases the lease); the follower must take
+        # over and keep producing fresh decisions
+        instances[first_leader][1].stop(release=True)
+        other = 1 - first_leader
+
+        assert wait_for(lambda: instances[other][1].is_leader()), "no takeover"
+        # capture the baseline only AFTER takeover: the outgoing leader's
+        # loop may still finish one last cycle around its stop(), which
+        # would otherwise satisfy the freshness check for it
+        stamp = client.get_variant_autoscaling(
+            NS, "llama-premium"
+        ).status.desired_optimized_alloc.last_run_time
+
+        def fresh_decision():
+            va = client.get_variant_autoscaling(NS, "llama-premium")
+            return (va.status.desired_optimized_alloc.last_run_time or "") > (stamp or "")
+
+        assert wait_for(fresh_decision), "follower never wrote a fresh decision"
+        lease = client.get_lease(CFG_NS, LeaderElector.lease_name)
+        assert lease["spec"]["holderIdentity"] == instances[other][1].identity
+    finally:
+        for stop in stops:
+            stop["stop"] = True
+        for rec, elector, watcher, t in instances:
+            rec.poke()
+            watcher.stop()
+            elector.stop()
+        for _, _, _, t in instances:
+            t.join(timeout=5)
+
+
 # -- full cycle over HTTP -----------------------------------------------------
 
 
@@ -283,24 +398,7 @@ def test_run_cycle_scales_real_deployment_over_http(server, client):
 
     from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
 
-    post(server, f"/api/v1/namespaces/{CFG_NS}/configmaps", {
-        "metadata": {"name": "accelerator-unit-costs", "namespace": CFG_NS},
-        "data": {"v5e-4": json.dumps({"cost": 10.0})},
-    })
-    post(server, f"/api/v1/namespaces/{CFG_NS}/configmaps", {
-        "metadata": {"name": "service-classes-config", "namespace": CFG_NS},
-        "data": {"premium.yaml": (
-            "name: Premium\npriority: 1\ndata:\n"
-            "  - model: meta/llama-3.1-8b\n    slo-ttft: 500\n    slo-tpot: 24\n"
-        )},
-    })
-    post(server, f"/api/v1/namespaces/{CFG_NS}/configmaps", {
-        "metadata": {"name": "inferno-autoscaler-config", "namespace": CFG_NS},
-        "data": {"GLOBAL_OPT_INTERVAL": "30s"},
-    })
-    post(server, f"/apis/llmd.ai/v1alpha1/namespaces/{NS}/variantautoscalings",
-         make_va_doc())
-    add_deployment(server, NS, "llama-premium", replicas=1)
+    seed_cluster(server)
 
     rec = Reconciler(
         kube=client, prom=make_prom(arrival_rps=40.0),
