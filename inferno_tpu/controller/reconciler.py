@@ -262,6 +262,15 @@ class Reconciler:
         for sc in classes:
             t = sc.target_for(va.spec.model_id)
             if t is not None:
+                if preferred:
+                    # the fallback is reference parity, but silently sizing a
+                    # variant against a different class's SLOs (a typo'd
+                    # sloClassRef) must at least be visible in the logs
+                    self.log.warning(
+                        "%s: sloClassRef %r matched no class with model %s; "
+                        "falling back to class %r",
+                        va.full_name, preferred, va.spec.model_id, sc.name,
+                    )
                 return sc.name, t
         return None
 

@@ -279,3 +279,24 @@ def test_servicemonitor_scheme_matches_plain_http_listener():
     sm = next(d for d in docs if d and d.get("kind") == "ServiceMonitor")
     for ep in sm["spec"]["endpoints"]:
         assert ep["scheme"] == "http"  # MetricsServer is plain HTTP
+
+
+def test_multihost_lws_sample_validates():
+    """The multi-host sample pairs an LWS (4-host v5e-16 groups) with a
+    same-named VA; the VA must satisfy the CRD schema and the LWS must
+    carry whole-host group semantics the workload layer expects."""
+    schema = crd_schema()
+    path = os.path.join(REPO, "deploy/samples/multihost-lws-v5e-16.yaml")
+    docs = load_all(path)
+    kinds = {d["kind"] for d in docs}
+    assert {"LeaderWorkerSet", "VariantAutoscaling"} <= kinds
+    lws = next(d for d in docs if d["kind"] == "LeaderWorkerSet")
+    va = next(d for d in docs if d["kind"] == "VariantAutoscaling")
+    assert lws["metadata"]["name"] == va["metadata"]["name"]
+    assert lws["spec"]["leaderWorkerTemplate"]["size"] == 4  # v5e-16 / 4 per host
+    schema_check(va["spec"], schema["properties"]["spec"], va["metadata"]["name"])
+
+    from inferno_tpu.controller.workload import from_leader_worker_set
+
+    wl = from_leader_worker_set(lws)
+    assert (wl.group_size, wl.replicas) == (4, 1)
