@@ -13,3 +13,34 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 app.kubernetes.io/name: inferno-tpu-autoscaler
 app.kubernetes.io/instance: {{ .Release.Name }}
 {{- end -}}
+
+{{/* Sample-engine container list, shared by the Deployment and
+     LeaderWorkerSet renderings of the emulated engine. */}}
+{{- define "inferno.sampleEngineContainers" }}
+- name: engine
+  image: "{{ .Values.image.repository }}:{{ .Values.image.tag }}"
+  imagePullPolicy: {{ .Values.image.pullPolicy }}
+  command: ["python", "-m", "inferno_tpu.emulator.server"]
+  env:
+    - name: MODEL_ID
+      value: {{ .Values.sampleEngine.modelId | quote }}
+    - name: ENGINE
+      value: {{ .Values.controller.servingEngine | quote }}
+    - name: PORT
+      value: "8000"
+    - name: DECODE_ALPHA
+      value: {{ .Values.sampleEngine.decodeAlpha | quote }}
+    - name: DECODE_BETA
+      value: {{ .Values.sampleEngine.decodeBeta | quote }}
+    - name: PREFILL_GAMMA
+      value: {{ .Values.sampleEngine.prefillGamma | quote }}
+    - name: PREFILL_DELTA
+      value: {{ .Values.sampleEngine.prefillDelta | quote }}
+    - name: MAX_BATCH
+      value: {{ .Values.sampleEngine.maxBatch | quote }}
+  ports:
+    - containerPort: 8000
+      name: http
+  readinessProbe:
+    httpGet: {path: /healthz, port: 8000}
+{{- end }}

@@ -388,6 +388,55 @@ def test_two_instance_process_shape_with_failover(server):
             t.join(timeout=5)
 
 
+def test_inmemory_cluster_and_apiserver_agree(server, client):
+    """Differential guard: the same reconcile cycle against the in-memory
+    fake (used by most controller tests) and against the wire-level API
+    server must land the same status + scale. Keeps the fake honest —
+    drift between the two would silently undermine every test built on
+    InMemoryCluster."""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_controller import make_prom
+
+    from inferno_tpu.controller.kube import InMemoryCluster
+    from inferno_tpu.controller.crd import VariantAutoscaling
+    from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+
+    seed_cluster(server)
+
+    mem = InMemoryCluster()
+    mem.set_configmap(CFG_NS, "accelerator-unit-costs",
+                      {"v5e-4": json.dumps({"cost": 10.0})})
+    mem.set_configmap(CFG_NS, "service-classes-config", {
+        "premium.yaml": (
+            "name: Premium\npriority: 1\ndata:\n"
+            "  - model: meta/llama-3.1-8b\n    slo-ttft: 500\n    slo-tpot: 24\n"
+        ),
+    })
+    mem.set_configmap(CFG_NS, "inferno-autoscaler-config",
+                      {"GLOBAL_OPT_INTERVAL": "30s"})
+    mem.add_variant_autoscaling(VariantAutoscaling.from_dict(make_va_doc()))
+    mem.add_deployment(NS, "llama-premium", replicas=1)
+
+    outcomes = {}
+    for name, kube in (("rest", client), ("memory", mem)):
+        rec = Reconciler(
+            kube=kube, prom=make_prom(arrival_rps=40.0),
+            config=ReconcilerConfig(config_namespace=CFG_NS,
+                                    compute_backend="scalar", direct_scale=True),
+        )
+        report = rec.run_cycle()
+        assert report.errors == [], (name, report.errors)
+        va = kube.get_variant_autoscaling(NS, "llama-premium")
+        outcomes[name] = (
+            va.status.desired_optimized_alloc.num_replicas,
+            va.status.desired_optimized_alloc.accelerator,
+            va.status.condition("OptimizationReady").status,
+            kube.get_deployment(NS, "llama-premium")["spec"]["replicas"],
+        )
+    assert outcomes["rest"] == outcomes["memory"], outcomes
+
+
 # -- full cycle over HTTP -----------------------------------------------------
 
 

@@ -300,3 +300,48 @@ def test_multihost_lws_sample_validates():
 
     wl = from_leader_worker_set(lws)
     assert (wl.group_size, wl.replicas) == (4, 1)
+
+
+def test_helm_templates_structurally_sound():
+    """No helm binary ships in this image, so guard the chart against the
+    template-parse failure classes that break `helm template` for every
+    user regardless of values:
+
+    * `{{ define }}` anywhere except a *.tpl helper file — Go's template
+      parser only accepts define at top level, and a define nested in an
+      `if` body fails the WHOLE chart at load time;
+    * unbalanced {{ if/range/with/define }} ... {{ end }} nesting;
+    * every `include "name"` referring to a defined template.
+    """
+    import re
+
+    tmpl_dir = os.path.join(REPO, "charts/inferno-tpu-autoscaler/templates")
+    open_tag = re.compile(r"\{\{-?\s*(if|range|with|define)\b")
+    end_tag = re.compile(r"\{\{-?\s*end\b")
+    define_name = re.compile(r'\{\{-?\s*define\s+"([^"]+)"')
+    include_name = re.compile(r'include\s+"([^"]+)"')
+
+    defined, included = set(), set()
+    for fname in sorted(os.listdir(tmpl_dir)):
+        path = os.path.join(tmpl_dir, fname)
+        text = open(path).read()
+        defined |= set(define_name.findall(text))
+        included |= set(include_name.findall(text))
+        depth = 0
+        for m in re.finditer(r"\{\{-?\s*(if|range|with|define|end)\b", text):
+            word = m.group(1)
+            if word == "end":
+                depth -= 1
+                assert depth >= 0, f"{fname}: unbalanced 'end'"
+            else:
+                if word == "define" and not fname.endswith(".tpl"):
+                    # defines in manifest files are easy to nest by accident
+                    assert depth == 0, (
+                        f"{fname}: define nested inside a control block — "
+                        "Go templates reject this at chart load; move it to "
+                        "_helpers.tpl"
+                    )
+                depth += 1
+        assert depth == 0, f"{fname}: {depth} unclosed control block(s)"
+    missing = included - defined
+    assert not missing, f"include of undefined template(s): {missing}"
