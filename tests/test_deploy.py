@@ -316,8 +316,6 @@ def test_helm_templates_structurally_sound():
     import re
 
     tmpl_dir = os.path.join(REPO, "charts/inferno-tpu-autoscaler/templates")
-    open_tag = re.compile(r"\{\{-?\s*(if|range|with|define)\b")
-    end_tag = re.compile(r"\{\{-?\s*end\b")
     define_name = re.compile(r'\{\{-?\s*define\s+"([^"]+)"')
     include_name = re.compile(r'include\s+"([^"]+)"')
 
@@ -334,12 +332,12 @@ def test_helm_templates_structurally_sound():
                 depth -= 1
                 assert depth >= 0, f"{fname}: unbalanced 'end'"
             else:
-                if word == "define" and not fname.endswith(".tpl"):
-                    # defines in manifest files are easy to nest by accident
+                if word == "define":
+                    # Go rejects a define nested in a control block in ANY
+                    # file (.tpl included); top-level defines are depth 0
                     assert depth == 0, (
                         f"{fname}: define nested inside a control block — "
-                        "Go templates reject this at chart load; move it to "
-                        "_helpers.tpl"
+                        "Go templates reject this at chart load"
                     )
                 depth += 1
         assert depth == 0, f"{fname}: {depth} unclosed control block(s)"

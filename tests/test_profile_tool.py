@@ -1,0 +1,55 @@
+"""tools/profile_tpu.py resume/refusal logic — the parts that must fail
+FAST and correctly without a device (cross-model/dtype refusal happens
+before any jax device touch, so these tests need no TPU and would hang if
+the ordering regressed)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+TOOL = REPO / "tools/profile_tpu.py"
+
+
+def run_tool(tmp_path, *args, timeout=60):
+    return subprocess.run(
+        [sys.executable, str(TOOL), *args],
+        capture_output=True, text=True, timeout=timeout, cwd=tmp_path,
+    )
+
+
+def write_raw(path: Path, model: str, weight_dtype: str) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps({
+        "meta": {"model": model, "weight_dtype": weight_dtype,
+                 "dims": {"n_layers_full": 32}},
+        "decode": [], "prefill": [], "mixed": [],
+    }))
+
+
+def test_resume_refuses_cross_model_before_device_init(tmp_path):
+    out = tmp_path / "raw.json"
+    write_raw(out, "llama-3.2-1b", "bfloat16")
+    # 60s timeout << tunnel-init hang: a regression that orders device
+    # init before validation times this out instead of exiting cleanly
+    res = run_tool(tmp_path, "--model", "llama-3.1-8b", "--resume",
+                   "--out", str(out))
+    assert res.returncode != 0
+    assert "refusing --resume" in res.stderr
+    assert "llama-3.2-1b" in res.stderr
+
+
+def test_resume_refuses_cross_dtype(tmp_path):
+    out = tmp_path / "raw.json"
+    write_raw(out, "llama-3.1-8b", "bfloat16")
+    res = run_tool(tmp_path, "--model", "llama-3.1-8b", "--weight-dtype",
+                   "int8", "--resume", "--out", str(out))
+    assert res.returncode != 0
+    assert "weight_dtype" in res.stderr
+
+
+def test_unknown_model_rejected_by_argparse(tmp_path):
+    res = run_tool(tmp_path, "--model", "gpt-oss-999b")
+    assert res.returncode != 0
+    assert "invalid choice" in res.stderr

@@ -201,27 +201,6 @@ def main() -> None:
     args = ap.parse_args()
 
     dims = MODEL_PRESETS[args.model]
-    dev = jax.devices()[0]
-    rtt_ms = measure_rtt()
-    meta = {
-        "model": args.model,
-        "dims": {
-            "hidden": dims.hidden, "n_heads": dims.n_heads,
-            "n_kv_heads": dims.n_kv_heads, "head_dim": dims.head_dim,
-            "ffn": dims.ffn, "vocab": dims.vocab, "n_layers_full": dims.n_layers,
-        },
-        "device": {"kind": dev.device_kind, "platform": dev.platform},
-        "jax_version": jax.__version__,
-        "dtype": "bfloat16",
-        "weight_dtype": args.weight_dtype,
-        "decode_context": args.context,
-        "decode_steps_per_call": args.decode_steps,
-        "iters": args.iters,
-        "tunnel_rtt_ms": round(rtt_ms, 3),
-        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-    }
-    print(f"profiling on {dev.device_kind} ({dev.platform}); tunnel RTT {rtt_ms:.1f} ms", flush=True)
-
     if not args.out:
         suffix = "" if args.weight_dtype == "bfloat16" else f"_{args.weight_dtype}"
         args.out = f"profiles/raw/{args.model}_tpu{suffix}.json"
@@ -229,7 +208,11 @@ def main() -> None:
     out.parent.mkdir(parents=True, exist_ok=True)
     decode_out, prefill_out, mixed_out = [], [], []
     done: set = set()
+    prev_meta: dict = {}
     if args.resume and out.exists():
+        # validate the resume target BEFORE touching the device: a
+        # cross-model/dtype mismatch must fail fast, not after a slow
+        # (possibly hung) TPU-tunnel init
         prev = json.loads(out.read_text())
         prev_meta = prev.get("meta") or {}
         for key, want in (("model", args.model), ("weight_dtype", args.weight_dtype)):
@@ -252,8 +235,29 @@ def main() -> None:
             ("mixed", s["n_layers"], s["batch"], s["in_tokens"], s.get("context", args.context))
             for s in mixed_out
         }
-        meta = {**prev.get("meta", {}), **meta}
         print(f"resuming: {len(done)} configs already measured", flush=True)
+
+    dev = jax.devices()[0]
+    rtt_ms = measure_rtt()
+    meta = {
+        "model": args.model,
+        "dims": {
+            "hidden": dims.hidden, "n_heads": dims.n_heads,
+            "n_kv_heads": dims.n_kv_heads, "head_dim": dims.head_dim,
+            "ffn": dims.ffn, "vocab": dims.vocab, "n_layers_full": dims.n_layers,
+        },
+        "device": {"kind": dev.device_kind, "platform": dev.platform},
+        "jax_version": jax.__version__,
+        "dtype": "bfloat16",
+        "weight_dtype": args.weight_dtype,
+        "decode_context": args.context,
+        "decode_steps_per_call": args.decode_steps,
+        "iters": args.iters,
+        "tunnel_rtt_ms": round(rtt_ms, 3),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(f"profiling on {dev.device_kind} ({dev.platform}); tunnel RTT {rtt_ms:.1f} ms", flush=True)
+    meta = {**prev_meta, **meta}
 
     t0 = time.time()
 
