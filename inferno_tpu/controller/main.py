@@ -26,6 +26,10 @@ controller.go:516-582):
   DIRECT_SCALE                  true|false (default false; HPA otherwise)
   LEADER_ELECT                  true|false (default false; lease-based
                                 election for multi-replica deployments)
+  PROFILE_CORRECTION            true|false (default true; telemetry-driven
+                                recalibration of CR perf profiles —
+                                models/corrector.py; false = reference-
+                                exact static profiles)
 """
 
 from __future__ import annotations
@@ -115,6 +119,7 @@ def main() -> int:
             "COMPUTE_BACKEND", "tpu" if env_bool("USE_TPU_FLEET", True) else "scalar"
         ).lower(),
         direct_scale=env_bool("DIRECT_SCALE"),
+        profile_correction=env_bool("PROFILE_CORRECTION", True),
     )
     rec = Reconciler(kube=kube, prom=prom, config=config, emitter=emitter)
 
