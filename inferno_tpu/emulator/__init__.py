@@ -1,5 +1,11 @@
 from inferno_tpu.emulator.engine import EmulatedEngine, EngineProfile, RequestResult
-from inferno_tpu.emulator.loadgen import LoadGenerator, RateSpec
+from inferno_tpu.emulator.loadgen import (
+    SHAREGPT_INPUT,
+    SHAREGPT_OUTPUT,
+    LoadGenerator,
+    RateSpec,
+    TokenDistribution,
+)
 from inferno_tpu.emulator.miniprom import MiniProm, MiniPromClient
 from inferno_tpu.emulator.server import EmulatorServer, render_engine_metrics
 
@@ -9,6 +15,9 @@ __all__ = [
     "RequestResult",
     "LoadGenerator",
     "RateSpec",
+    "TokenDistribution",
+    "SHAREGPT_INPUT",
+    "SHAREGPT_OUTPUT",
     "MiniProm",
     "MiniPromClient",
     "EmulatorServer",

@@ -17,6 +17,34 @@ from inferno_tpu.emulator.engine import EmulatedEngine
 
 
 @dataclasses.dataclass(frozen=True)
+class TokenDistribution:
+    """Lognormal token-length distribution.
+
+    Conversation corpora (ShareGPT et al.) have heavy-tailed prompt and
+    completion lengths; the reference's e2e drives them through guidellm
+    (/root/reference/test/e2e-openshift/sharegpt_scaleup_test.go:39-227).
+    `sigma=0` degrades to a deterministic `median` for table tests.
+    """
+
+    median: float = 128.0
+    sigma: float = 0.0
+    max_tokens: int = 4096
+
+    def sample(self, rng: np.random.Generator) -> int:
+        if self.sigma <= 0.0:
+            return int(np.clip(round(self.median), 1, self.max_tokens))
+        v = rng.lognormal(mean=np.log(self.median), sigma=self.sigma)
+        return int(np.clip(round(v), 1, self.max_tokens))
+
+
+# Emulation presets approximating public ShareGPT conversation statistics
+# (median prompt a few hundred tokens, completions slightly shorter, both
+# with a long right tail).
+SHAREGPT_INPUT = TokenDistribution(median=160.0, sigma=1.1, max_tokens=2048)
+SHAREGPT_OUTPUT = TokenDistribution(median=120.0, sigma=0.9, max_tokens=1024)
+
+
+@dataclasses.dataclass(frozen=True)
 class RateSpec:
     """Piecewise schedule: list of (duration_seconds, req_per_sec)."""
 
@@ -44,11 +72,15 @@ class LoadGenerator:
         out_tokens: int = 64,
         poisson: bool = True,
         seed: int = 0,
+        in_dist: TokenDistribution | None = None,
+        out_dist: TokenDistribution | None = None,
     ):
         self.engines = engines
         self.rate = rate
         self.in_tokens = in_tokens
         self.out_tokens = out_tokens
+        self.in_dist = in_dist
+        self.out_dist = out_dist
         self.poisson = poisson
         self.rng = np.random.default_rng(seed)
         self.submitted = 0
@@ -72,8 +104,16 @@ class LoadGenerator:
             # round-robin across replicas (a crude load balancer)
             engine = self.engines[i % len(self.engines)]
             i += 1
-            out = max(1, int(self.rng.poisson(self.out_tokens)))
-            engine.submit(self.in_tokens, out)
+            if self.out_dist is not None:
+                out = self.out_dist.sample(self.rng)
+            else:
+                out = max(1, int(self.rng.poisson(self.out_tokens)))
+            inp = (
+                self.in_dist.sample(self.rng)
+                if self.in_dist is not None
+                else self.in_tokens
+            )
+            engine.submit(inp, out)
             self.submitted += 1
 
     def start(self) -> None:

@@ -1,0 +1,180 @@
+"""ShareGPT-style scale-up e2e: the hardware-free analogue of the
+reference's OpenShift real-vLLM scenario
+(/root/reference/test/e2e-openshift/sharegpt_scaleup_test.go:39-227).
+
+Shape of the reference test, reproduced at the sockets tier:
+  1. record the initial optimized/actual replica state,
+  2. verify the external-metrics surface (here: the controller's emitted
+     gauges, which prometheus-adapter would re-serve) matches CR status,
+  3. run a heavy-tailed "ShareGPT" load job — open-loop Poisson arrivals
+     with lognormal prompt/completion lengths — against the engine
+     endpoint, and assert the optimizer scales the variant out,
+  4. after the job completes, assert capacity is released again.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from inferno_tpu.controller.engines import (
+    LABEL_ACCELERATOR,
+    LABEL_OUT_NAMESPACE,
+    LABEL_VARIANT,
+)
+from inferno_tpu.controller.promclient import HttpPromClient, PromConfig
+from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+from inferno_tpu.emulator.engine import EngineProfile
+from inferno_tpu.emulator.loadgen import TokenDistribution
+from inferno_tpu.emulator.miniprom import MiniProm
+from inferno_tpu.emulator.server import EmulatorServer
+
+from test_controller import CFG_NS, MODEL, NS, make_cluster
+
+TIME_SCALE = 0.02
+WINDOW = 3.0
+SCRAPE = 0.2
+
+# Tails capped well below the presets so the emulated "job" finishes in
+# test time; the shape (lognormal, sigma ~ 1) is what matters.
+IN_DIST = TokenDistribution(median=96.0, sigma=1.0, max_tokens=512)
+OUT_DIST = TokenDistribution(median=48.0, sigma=0.8, max_tokens=192)
+
+
+class ShareGPTJob:
+    """Open-loop Poisson load with lognormal token lengths over HTTP —
+    the guidellm-job stand-in. Fire-and-forget: each arrival gets its own
+    thread, as an open-loop generator must (a closed loop would throttle
+    itself to the engine's capacity and mask the overload)."""
+
+    def __init__(self, port: int, rate_rps: float, num_prompts: int, seed: int = 7):
+        self.url = f"http://127.0.0.1:{port}/v1/chat/completions"
+        self.rate = rate_rps
+        self.num_prompts = num_prompts
+        self.rng = np.random.default_rng(seed)
+        self.completed = 0
+        self.failed = 0
+        self._lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+
+    def _one(self, in_tokens: int, out_tokens: int) -> None:
+        body = json.dumps(
+            {
+                "model": MODEL,
+                "messages": [{"role": "user", "content": "x " * in_tokens}],
+                "max_tokens": out_tokens,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self.url, data=body, headers={"Content-Type": "application/json"}
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60).read()
+            with self._lock:
+                self.completed += 1
+        except OSError:
+            with self._lock:
+                self.failed += 1
+
+    def run(self) -> None:
+        """Blocks until all prompts are submitted (not completed)."""
+        for _ in range(self.num_prompts):
+            time.sleep(float(self.rng.exponential(1.0 / self.rate)))
+            t = threading.Thread(
+                target=self._one,
+                args=(IN_DIST.sample(self.rng), OUT_DIST.sample(self.rng)),
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def wait(self, timeout: float) -> None:
+        deadline = time.time() + timeout
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.time()))
+
+
+@pytest.fixture()
+def stack():
+    srv = EmulatorServer(
+        model_id=MODEL,
+        profile=EngineProfile(alpha=18.0, beta=0.3, gamma=5.0, delta=0.02, max_batch=64),
+        engine_name="vllm-tpu",
+        time_scale=TIME_SCALE,
+    )
+    srv.start()
+    prom = MiniProm(
+        [(f"http://127.0.0.1:{srv.port}/metrics", {"namespace": NS})],
+        scrape_interval=SCRAPE,
+        window_seconds=WINDOW,
+    )
+    prom.start()
+    cluster = make_cluster(replicas=1)
+    rec = Reconciler(
+        kube=cluster,
+        prom=HttpPromClient(PromConfig(base_url=prom.url, allow_http=True)),
+        config=ReconcilerConfig(
+            config_namespace=CFG_NS, compute_backend="scalar", direct_scale=True,
+        ),
+    )
+    yield srv, prom, cluster, rec
+    prom.stop()
+    srv.stop()
+
+
+def test_sharegpt_scaleup_and_release(stack):
+    srv, prom, cluster, rec = stack
+
+    # -- 1. initial state ---------------------------------------------------
+    rec.run_cycle()
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    initial_optimized = va.status.desired_optimized_alloc.num_replicas
+    initial_replicas = cluster.get_deployment(NS, "llama-premium")["spec"]["replicas"]
+    assert initial_optimized <= 1
+
+    # -- 2. external-metrics surface ----------------------------------------
+    labels = {
+        LABEL_OUT_NAMESPACE: NS,
+        LABEL_VARIANT: "llama-premium",
+        LABEL_ACCELERATOR: "v5e-4",
+    }
+    assert rec.emitter.desired_replicas.get(labels) == float(initial_optimized)
+    assert rec.emitter.current_replicas.get(labels) == float(initial_replicas)
+
+    # -- 3. the ShareGPT job ------------------------------------------------
+    job = ShareGPTJob(srv.port, rate_rps=30.0, num_prompts=90)
+    runner = threading.Thread(target=job.run, daemon=True)
+    runner.start()
+    time.sleep(2.0)  # let the rate window fill while the job is running
+
+    rec.run_cycle()
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    scaled_optimized = va.status.desired_optimized_alloc.num_replicas
+    assert scaled_optimized > initial_optimized, (initial_optimized, scaled_optimized)
+    assert scaled_optimized > 1
+
+    # heavy-tailed lengths flow through collector averages: the observed
+    # mean completion length must exceed the lognormal median (tail pull)
+    load = va.status.current_alloc.load
+    assert load.arrival_rate > 0
+    assert load.avg_output_tokens > OUT_DIST.median * 0.8
+
+    # actuation + gauge/status agreement under load
+    assert cluster.get_deployment(NS, "llama-premium")["spec"]["replicas"] == scaled_optimized
+    assert rec.emitter.desired_replicas.get(labels) == float(scaled_optimized)
+
+    runner.join()
+    job.wait(timeout=30.0)
+    assert job.failed == 0, f"{job.failed} requests failed"
+    assert job.completed == 90
+
+    # -- 4. release after the job -------------------------------------------
+    time.sleep(WINDOW + 3 * SCRAPE)  # arrivals age out of the rate window
+    rec.run_cycle()
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    released = va.status.desired_optimized_alloc.num_replicas
+    assert released < scaled_optimized
+    assert released <= max(initial_optimized, 1)
