@@ -57,6 +57,16 @@ def bisect_monotone(
     if within_tolerance(y_hi, y_target, tolerance):
         return BisectionResult(x_max, 0)
 
+    if y_lo == y_hi:
+        # Flat curve with no crossing (e.g. degenerate single-token
+        # workloads where ITL is rate-independent): report which side the
+        # target lies on instead of misreading flat as decreasing — the
+        # reference errs here and calls a met-everywhere target
+        # "unachievable" (pkg/analyzer/utils.go:40-44).
+        if y_target > y_lo:
+            return BisectionResult(x_max, +1)
+        return BisectionResult(x_min, -1)
+
     increasing = y_lo < y_hi
     if (increasing and y_target < y_lo) or (not increasing and y_target > y_lo):
         return BisectionResult(x_min, -1)

@@ -21,3 +21,53 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+# Shared timing for the sockets-e2e tier: compress emulated time so a
+# "minute" of traffic fits a test run.
+E2E_TIME_SCALE = 0.02
+E2E_WINDOW = 3.0
+E2E_SCRAPE = 0.2
+
+
+@pytest.fixture()
+def e2e_stack():
+    """Emulated engine HTTP server -> MiniProm scrape -> HttpPromClient ->
+    reconciler with direct-scale actuation, torn down in order. Shared by
+    the sockets-e2e suites (test_e2e_http, test_e2e_sharegpt)."""
+    from inferno_tpu.controller.promclient import HttpPromClient, PromConfig
+    from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+    from inferno_tpu.emulator.engine import EngineProfile
+    from inferno_tpu.emulator.miniprom import MiniProm
+    from inferno_tpu.emulator.server import EmulatorServer
+    from test_controller import CFG_NS, MODEL, NS, make_cluster
+
+    srv = EmulatorServer(
+        model_id=MODEL,
+        profile=EngineProfile(alpha=18.0, beta=0.3, gamma=5.0, delta=0.02, max_batch=64),
+        engine_name="vllm-tpu",
+        time_scale=E2E_TIME_SCALE,
+    )
+    srv.start()
+    # the namespace label arrives via target relabeling, as a
+    # ServiceMonitor would attach it on a real cluster
+    prom = MiniProm(
+        [(f"http://127.0.0.1:{srv.port}/metrics", {"namespace": NS})],
+        scrape_interval=E2E_SCRAPE,
+        window_seconds=E2E_WINDOW,
+    )
+    prom.start()
+    cluster = make_cluster(replicas=1)
+    rec = Reconciler(
+        kube=cluster,
+        prom=HttpPromClient(PromConfig(base_url=prom.url, allow_http=True)),
+        config=ReconcilerConfig(
+            config_namespace=CFG_NS,
+            compute_backend="scalar",
+            direct_scale=True,
+        ),
+    )
+    yield srv, prom, cluster, rec
+    prom.stop()
+    srv.stop()

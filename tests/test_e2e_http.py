@@ -30,43 +30,8 @@ from test_controller import CFG_NS, MODEL, NS, make_cluster
 
 FREE_MODEL = "other/model"
 
-# compress emulated time so a "minute" of traffic fits a test run
-TIME_SCALE = 0.02
-WINDOW = 3.0
-SCRAPE = 0.2
-
-
-@pytest.fixture()
-def stack():
-    srv = EmulatorServer(
-        model_id=MODEL,
-        profile=EngineProfile(alpha=18.0, beta=0.3, gamma=5.0, delta=0.02, max_batch=64),
-        engine_name="vllm-tpu",
-        time_scale=TIME_SCALE,
-    )
-    srv.start()
-    # the namespace label arrives via target relabeling, as a
-    # ServiceMonitor would attach it on a real cluster
-    prom = MiniProm(
-        [(f"http://127.0.0.1:{srv.port}/metrics", {"namespace": NS})],
-        scrape_interval=SCRAPE,
-        window_seconds=WINDOW,
-    )
-    prom.start()
-    client = HttpPromClient(PromConfig(base_url=prom.url, allow_http=True))
-    cluster = make_cluster(replicas=1)
-    rec = Reconciler(
-        kube=cluster,
-        prom=client,
-        config=ReconcilerConfig(
-            config_namespace=CFG_NS,
-            compute_backend="scalar",
-            direct_scale=True,
-        ),
-    )
-    yield srv, prom, cluster, rec
-    prom.stop()
-    srv.stop()
+# e2e stack + timing shared with test_e2e_sharegpt (tests/conftest.py)
+from conftest import E2E_SCRAPE as SCRAPE, E2E_TIME_SCALE as TIME_SCALE, E2E_WINDOW as WINDOW
 
 
 def _post_load(port: int, duration_s: float, concurrency: int = 6):
@@ -99,8 +64,8 @@ def _post_load(port: int, duration_s: float, concurrency: int = 6):
         t.join()
 
 
-def test_scale_out_under_load_and_in_at_idle(stack):
-    srv, prom, cluster, rec = stack
+def test_scale_out_under_load_and_in_at_idle(e2e_stack):
+    srv, prom, cluster, rec = e2e_stack
 
     # -- phase 1: sustained load -> scale out -------------------------------
     _post_load(srv.port, duration_s=2.0)
@@ -134,13 +99,13 @@ def test_scale_out_under_load_and_in_at_idle(stack):
     assert va.status.desired_optimized_alloc.num_replicas == 1
 
 
-def test_scale_out_through_tpu_fleet_kernel(stack):
+def test_scale_out_through_tpu_fleet_kernel(e2e_stack):
     """The same sockets e2e with compute_backend="tpu": the batched XLA
     fleet kernel (not the scalar loop) sizes the candidates inside a full
     collector -> kernel -> solver -> actuation cycle. Catches
     integration-level drift the lane-by-lane unit parity tests cannot
     (VERDICT r2 weak #3)."""
-    srv, prom, cluster, _ = stack
+    srv, prom, cluster, _ = e2e_stack
     rec = Reconciler(
         kube=cluster,
         prom=HttpPromClient(PromConfig(base_url=prom.url, allow_http=True)),
@@ -281,12 +246,12 @@ def test_multi_va_priority_contention_limited_capacity():
         free_srv.stop()
 
 
-def test_collector_fallback_without_namespace_label(stack):
+def test_collector_fallback_without_namespace_label(e2e_stack):
     """A scrape without target relabeling exposes model_name but no
     namespace label: the collector's namespaced validation query returns
     empty and the namespace-less fallback must carry
     (reference collector.go:113-137)."""
-    srv, _, cluster, rec = stack
+    srv, _, cluster, rec = e2e_stack
     bare = MiniProm(
         [f"http://127.0.0.1:{srv.port}/metrics"],
         scrape_interval=SCRAPE,
@@ -305,10 +270,10 @@ def test_collector_fallback_without_namespace_label(stack):
         bare.stop()
 
 
-def test_miniprom_wire_format(stack):
+def test_miniprom_wire_format(e2e_stack):
     """HttpPromClient parses MiniProm's JSON exactly as it would a real
     Prometheus response."""
-    srv, prom, cluster, rec = stack
+    srv, prom, cluster, rec = e2e_stack
     _post_load(srv.port, duration_s=0.6, concurrency=2)
     time.sleep(2 * SCRAPE)
     client = HttpPromClient(PromConfig(base_url=prom.url, allow_http=True))

@@ -25,18 +25,12 @@ from inferno_tpu.controller.engines import (
     LABEL_OUT_NAMESPACE,
     LABEL_VARIANT,
 )
-from inferno_tpu.controller.promclient import HttpPromClient, PromConfig
-from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
-from inferno_tpu.emulator.engine import EngineProfile
 from inferno_tpu.emulator.loadgen import TokenDistribution
-from inferno_tpu.emulator.miniprom import MiniProm
-from inferno_tpu.emulator.server import EmulatorServer
 
-from test_controller import CFG_NS, MODEL, NS, make_cluster
+from test_controller import NS
+from conftest import E2E_SCRAPE as SCRAPE, E2E_WINDOW as WINDOW
 
-TIME_SCALE = 0.02
-WINDOW = 3.0
-SCRAPE = 0.2
+MODEL = "meta-llama/Llama-3.1-8B"
 
 # Tails capped well below the presets so the emulated "job" finishes in
 # test time; the shape (lognormal, sigma ~ 1) is what matters.
@@ -97,36 +91,8 @@ class ShareGPTJob:
             t.join(max(0.0, deadline - time.time()))
 
 
-@pytest.fixture()
-def stack():
-    srv = EmulatorServer(
-        model_id=MODEL,
-        profile=EngineProfile(alpha=18.0, beta=0.3, gamma=5.0, delta=0.02, max_batch=64),
-        engine_name="vllm-tpu",
-        time_scale=TIME_SCALE,
-    )
-    srv.start()
-    prom = MiniProm(
-        [(f"http://127.0.0.1:{srv.port}/metrics", {"namespace": NS})],
-        scrape_interval=SCRAPE,
-        window_seconds=WINDOW,
-    )
-    prom.start()
-    cluster = make_cluster(replicas=1)
-    rec = Reconciler(
-        kube=cluster,
-        prom=HttpPromClient(PromConfig(base_url=prom.url, allow_http=True)),
-        config=ReconcilerConfig(
-            config_namespace=CFG_NS, compute_backend="scalar", direct_scale=True,
-        ),
-    )
-    yield srv, prom, cluster, rec
-    prom.stop()
-    srv.stop()
-
-
-def test_sharegpt_scaleup_and_release(stack):
-    srv, prom, cluster, rec = stack
+def test_sharegpt_scaleup_and_release(e2e_stack):
+    srv, prom, cluster, rec = e2e_stack
 
     # -- 1. initial state ---------------------------------------------------
     rec.run_cycle()

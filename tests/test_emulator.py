@@ -309,6 +309,38 @@ def test_e2e_p95_ttft_meets_raw_slo_under_poisson_load():
         engine.stop()
 
 
+def test_loadgen_token_distributions_reach_engine():
+    """LoadGenerator's in_dist/out_dist plumbing: heavy-tailed lognormal
+    lengths must arrive at the engine as submitted — prompt lengths vary,
+    spread far beyond the median, and respect the clamp."""
+    from inferno_tpu.emulator import SHAREGPT_INPUT, SHAREGPT_OUTPUT
+
+    engine = EmulatedEngine(
+        EngineProfile(alpha=0.5, beta=0.01, gamma=0.2, delta=0.0005, max_batch=64),
+        time_scale=0.002,
+    )
+    engine.start()
+    try:
+        gen = LoadGenerator([engine], RateSpec(phases=((1.5, 80.0),)),
+                            in_dist=SHAREGPT_INPUT, out_dist=SHAREGPT_OUTPUT,
+                            seed=11)
+        gen.start()
+        gen.join(20)
+        time.sleep(1.5)
+        comps = [r for _, r in engine.completions]
+        assert len(comps) >= 60
+        ins = sorted(c.in_tokens for c in comps)
+        outs = [c.out_tokens for c in comps]
+        med = ins[len(ins) // 2]
+        assert len(set(ins)) > 10  # actually sampled, not a constant
+        assert ins[-1] > 3 * med  # lognormal right tail
+        assert ins[-1] <= SHAREGPT_INPUT.max_tokens
+        assert max(outs) <= SHAREGPT_OUTPUT.max_tokens
+        assert min(ins) >= 1 and min(outs) >= 1
+    finally:
+        engine.stop()
+
+
 def test_e2e_observed_itl_matches_profile():
     """Closed loop sanity: emulated ITL should track alpha + beta*batch."""
     engine = EmulatedEngine(FAST)
