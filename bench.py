@@ -267,6 +267,24 @@ def time_cycles(step, spec, repeats: int) -> float:
     return statistics.median(times)
 
 
+def _device_roundtrip_ms() -> float:
+    """Latency floor of ONE host->device->host synchronization, measured
+    with fresh arrays (jax caches fetches on the buffer, so reusing one
+    array would read back ~0). The fleet cycle is designed to pay exactly
+    one such round trip (`parallel/fleet._solve_all`); on this box the
+    TPU sits behind a network tunnel, so this floor — not kernel compute,
+    which is sub-millisecond — dominates `tpu_ms`."""
+    import jax
+
+    xs = []
+    for i in range(5):
+        a = np.full((16,), float(i), np.float32)
+        t0 = time.perf_counter()
+        np.asarray(jax.device_put(a))
+        xs.append((time.perf_counter() - t0) * 1000.0)
+    return statistics.median(xs)
+
+
 def fleet_cycle_metrics(full: bool = True) -> dict:
     spec = build_spec(64)  # 64 variants x 8 shapes = 512 lanes
     opt = spec.optimizer
@@ -299,6 +317,10 @@ def fleet_cycle_metrics(full: bool = True) -> dict:
         # XLA program is designed for TPU (r02 measured ~100 ms there); on
         # a CPU fallback the C++ backend is the intended fast path
         "platform": jax.default_backend(),
+        # the one-sync latency floor: tpu_ms = this + ~15ms host work; the
+        # kernel itself is sub-millisecond (device-resident inputs measure
+        # ~= the floor), so on a co-located TPU host the cycle is ~16ms
+        "device_roundtrip_ms": round(_device_roundtrip_ms(), 3),
         "lanes_512": {
             "tpu_ms": round(tpu_ms, 3),
             "scalar_ms": round(scalar_ms, 3),

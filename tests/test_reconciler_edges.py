@@ -1,0 +1,245 @@
+"""Reconciler failure-path behavior specs.
+
+The analogue of the reference controller suite's failure scenarios
+(/root/reference/internal/controller/variantautoscaling_controller_test.go):
+optimizer failure marking every prepared VA, per-VA skip-and-continue in
+the apply phase, metric-emission failures not failing the cycle, and the
+tolerant ConfigMap parsing the controller promises.
+"""
+
+import json
+
+import pytest
+
+from inferno_tpu.controller import InMemoryCluster, Reconciler, ReconcilerConfig
+from inferno_tpu.controller.crd import (
+    TYPE_OPTIMIZATION_READY,
+    REASON_OPTIMIZATION_FAILED,
+)
+from inferno_tpu.controller.kube import KubeError
+
+from test_controller import CFG_NS, NS, make_cluster, make_prom
+
+
+def reconciler(cluster, prom, **kw):
+    cfg = ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar", **kw)
+    return Reconciler(kube=cluster, prom=prom, config=cfg)
+
+
+def flaky_cluster(cls):
+    """make_cluster()'s seeded state rehosted onto an error-injecting
+    subclass (one shared transplant point: instance state lives in
+    __dict__ for InMemoryCluster)."""
+    cluster = cls()
+    cluster.__dict__.update(make_cluster().__dict__)
+    return cluster
+
+
+def add_second_variant(cluster):
+    """A second healthy variant so per-VA skip behavior is observable."""
+    import copy
+
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    va2 = copy.deepcopy(va)
+    va2.name = "llama-second"
+    cluster.add_variant_autoscaling(va2)
+    cluster.add_deployment(NS, "llama-second", replicas=1)
+    return va2
+
+
+# -- optimize failure marks ALL prepared VAs (controller.go:164-186) ---------
+
+
+def test_optimize_failure_marks_every_prepared_va(monkeypatch):
+    cluster = make_cluster()
+    add_second_variant(cluster)
+    rec = reconciler(cluster, make_prom())
+
+    class Boom:
+        def __init__(self, spec):
+            pass
+
+        def optimize(self, system, calculate=False):
+            raise RuntimeError("solver exploded")
+
+    monkeypatch.setattr("inferno_tpu.controller.reconciler.Optimizer", Boom)
+    report = rec.run_cycle()
+    assert not report.optimization_ok
+    assert any("solver exploded" in e for e in report.errors)
+    for name in ("llama-premium", "llama-second"):
+        va = cluster.get_variant_autoscaling(NS, name)
+        cond = va.status.condition(TYPE_OPTIMIZATION_READY)
+        assert cond is not None and cond.status == "False", name
+        assert cond.reason == REASON_OPTIMIZATION_FAILED
+
+
+def test_optimize_failure_is_retried_next_cycle(monkeypatch):
+    cluster = make_cluster()
+    rec = reconciler(cluster, make_prom())
+
+    class Boom:
+        def __init__(self, spec):
+            pass
+
+        def optimize(self, system, calculate=False):
+            raise RuntimeError("transient")
+
+    monkeypatch.setattr("inferno_tpu.controller.reconciler.Optimizer", Boom)
+    assert not rec.run_cycle().optimization_ok
+    monkeypatch.undo()
+    report = rec.run_cycle()  # no code change needed: next cycle recovers
+    assert report.optimization_ok
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    assert va.status.condition(TYPE_OPTIMIZATION_READY).status == "True"
+
+
+# -- apply-phase per-VA skip (controller.go:338-407) -------------------------
+
+
+def test_refetch_failure_skips_one_applies_other():
+    class Flaky(InMemoryCluster):
+        def get_variant_autoscaling(self, namespace, name):
+            if name == "llama-premium" and getattr(self, "_arm", False):
+                raise KubeError("apiserver hiccup")
+            return super().get_variant_autoscaling(namespace, name)
+
+    cluster = flaky_cluster(Flaky)
+    add_second_variant(cluster)
+    rec = reconciler(cluster, make_prom())
+    cluster._arm = True
+
+    report = rec.run_cycle()
+    assert any("refetch" in e for e in report.errors)
+    # the healthy variant still got its status applied
+    assert report.variants_applied == 1
+    ok = cluster.get_variant_autoscaling(NS, "llama-second")
+    assert ok.status.condition(TYPE_OPTIMIZATION_READY).status == "True"
+    assert ok.status.desired_optimized_alloc.num_replicas >= 1
+
+
+def test_status_update_failure_recorded_cycle_continues():
+    class Flaky(InMemoryCluster):
+        def update_variant_autoscaling_status(self, va):
+            if va.name == "llama-premium" and getattr(self, "_arm", False):
+                raise KubeError("write denied")
+            return super().update_variant_autoscaling_status(va)
+
+    cluster = flaky_cluster(Flaky)
+    add_second_variant(cluster)
+    rec = reconciler(cluster, make_prom())
+    cluster._arm = True
+
+    report = rec.run_cycle()
+    assert any("status" in e and "write denied" in e for e in report.errors)
+    assert report.variants_applied == 1  # the other one landed
+
+
+def test_emit_metrics_failure_does_not_fail_cycle(monkeypatch):
+    cluster = make_cluster()
+    rec = reconciler(cluster, make_prom())
+
+    def boom(va):
+        raise KubeError("metrics sink down")
+
+    monkeypatch.setattr(rec.actuator, "emit_metrics", boom)
+    report = rec.run_cycle()
+    # the cycle is healthy, status still written, actuation flagged false
+    # (reference: actuator.go:69-74)
+    assert report.optimization_ok
+    assert report.variants_applied == 1
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    assert va.status.actuation_applied is False
+    assert va.status.condition(TYPE_OPTIMIZATION_READY).status == "True"
+    assert va.status.desired_optimized_alloc.num_replicas >= 1
+
+
+def test_list_failure_aborts_cycle_cleanly():
+    class Down(InMemoryCluster):
+        def list_variant_autoscalings(self):
+            raise KubeError("apiserver down")
+
+    cluster = flaky_cluster(Down)
+    rec = reconciler(cluster, make_prom())
+    report = rec.run_cycle()
+    assert not report.optimization_ok
+    assert any("list" in e for e in report.errors)
+    assert report.variants_seen == 0
+
+
+# -- squeezed-out floor (limited mode, no feasible allocation) ---------------
+
+
+@pytest.mark.parametrize("scale_to_zero,floor", [(False, 1), (True, 0)])
+def test_capacity_exhausted_floors_desired(scale_to_zero, floor):
+    cluster = make_cluster(replicas=3)
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {
+        "GLOBAL_OPT_INTERVAL": "30s",
+        "OPTIMIZER_MODE": "limited",
+        "TPU_CAPACITY": json.dumps({"v5e": 0}),  # nothing to give
+    })
+    rec = reconciler(cluster, make_prom(), scale_to_zero=scale_to_zero)
+    report = rec.run_cycle()
+    assert report.optimization_ok, report.errors
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    cond = va.status.condition(TYPE_OPTIMIZATION_READY)
+    assert cond.status == "False" and cond.reason == REASON_OPTIMIZATION_FAILED
+    assert va.status.desired_optimized_alloc.num_replicas == floor
+
+
+# -- tolerant ConfigMap parsing ---------------------------------------------
+
+
+@pytest.mark.parametrize("raw,expect", [
+    ("45s", 45),
+    ("45", 45),
+    ("2m", 30),        # unsupported unit -> configured default (30 here)
+    ("garbage", 30),
+    ("0", 30),         # zero is not a usable interval
+    ("", 30),
+])
+def test_interval_parsing(raw, expect):
+    cluster = make_cluster()
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config",
+                          {"GLOBAL_OPT_INTERVAL": raw})
+    rec = reconciler(cluster, make_prom())
+    rec.config.interval_seconds = 30
+    assert rec.read_interval() == expect
+
+
+def test_malformed_accelerator_entries_skipped():
+    cluster = make_cluster()
+    cluster.set_configmap(CFG_NS, "accelerator-unit-costs", {
+        "v5e-4": json.dumps({"cost": 10.0}),
+        "v5e-16": "{not json",
+    })
+    rec = reconciler(cluster, make_prom())
+    accs = rec.read_accelerators()
+    assert [a.name for a in accs] == ["v5e-4"]
+    assert accs[0].cost_per_chip_hr == 10.0
+
+
+def test_malformed_service_class_docs_skipped():
+    cluster = make_cluster()
+    cluster.set_configmap(CFG_NS, "service-classes-config", {
+        "good.yaml": "name: Premium\npriority: 1\ndata:\n"
+                     "  - model: m\n    slo-ttft: 500\n    slo-tpot: 24\n",
+        "noname.yaml": "priority: 3\n",
+        "notmap.yaml": "- just\n- a list\n",
+        "broken.yaml": "::: not yaml {{{",
+    })
+    rec = reconciler(cluster, make_prom())
+    classes = rec.read_service_classes()
+    assert [c.name for c in classes] == ["Premium"]
+    assert classes[0].model_targets[0].slo_ttft == 500.0
+
+
+def test_capacity_parsing_tolerates_bad_json():
+    cluster = make_cluster()
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {
+        "OPTIMIZER_MODE": "unlimited",
+        "TPU_CAPACITY": "{broken",
+    })
+    rec = reconciler(cluster, make_prom())
+    optimizer, capacity = rec.read_optimizer_and_capacity()
+    assert optimizer.unlimited
+    assert capacity.chips == {}
