@@ -79,8 +79,25 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.c_int32,  # n_threads
                 _U8, _D, _D, _I, _D, _D, _D, _D,  # outputs
             ]
+            tfn = lib.inferno_tandem_size
+            tfn.restype = ctypes.c_int
+            tfn.argtypes = [
+                ctypes.c_int32,  # n_lanes
+                _D, _D, _D, _D,  # alpha beta gamma delta
+                _D, _D,  # in_tokens out_tokens
+                _I, _I, _I, _I,  # prefill/decode batch, prefill/decode cap
+                _D, _D,  # prefill_slices decode_slices
+                _D, _D, _D,  # targets ttft itl tps
+                _D, _I, _D,  # total_rate min_replicas cost_per_replica
+                ctypes.c_int32,  # n_iters
+                ctypes.c_double,  # ttft_tail_margin
+                ctypes.c_int32,  # n_threads
+                _U8, _D, _D, _I, _D, _D, _D, _D,  # outputs
+            ]
             _lib = lib
-        except (OSError, subprocess.CalledProcessError) as e:
+        except (OSError, subprocess.CalledProcessError, AttributeError) as e:
+            # AttributeError: a stale prebuilt .so missing a newer symbol
+            # (e.g. inferno_tandem_size) must report unavailable, not crash
             _load_error = str(e)
     return _lib
 
@@ -107,16 +124,19 @@ class NativeFleetResult(NamedTuple):
     rho: np.ndarray
 
 
-def fleet_size_native(
-    params, n_iters: int = DEFAULT_BISECT_ITERS, n_threads: int = 0,
-    ttft_tail_margin: float | None = None,
-) -> NativeFleetResult:
-    """Size every lane of a FleetParams batch with the C++ solver.
+def _d(a):
+    return np.ascontiguousarray(np.asarray(a), dtype=np.float64)
 
-    `params` is any structure with the FleetParams fields (numpy or jax
-    arrays). Semantics match ops.queueing.fleet_size, including the
-    percentile TTFT interpretation (default SLO_MARGIN); precision is f64.
-    """
+
+def _i(a):
+    return np.ascontiguousarray(np.asarray(a), dtype=np.int32)
+
+
+def _run_sizer(symbol: str, inputs: tuple, n: int, n_iters: int,
+               ttft_tail_margin: float | None, n_threads: int) -> NativeFleetResult:
+    """Shared marshalling for the C sizers: zero-init the 8 result arrays,
+    invoke `symbol` as (n, *inputs, n_iters, margin, n_threads, *outputs),
+    check rc, and re-type feasibility."""
     if ttft_tail_margin is None:
         from inferno_tpu.config.defaults import SLO_MARGIN
 
@@ -124,15 +144,6 @@ def fleet_size_native(
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native library unavailable: {_load_error}")
-
-    def d(a):
-        return np.ascontiguousarray(np.asarray(a), dtype=np.float64)
-
-    def i(a):
-        return np.ascontiguousarray(np.asarray(a), dtype=np.int32)
-
-    alpha = d(params.alpha)
-    n = alpha.shape[0]
     if n_threads <= 0:
         n_threads = os.cpu_count() or 1
     out = NativeFleetResult(
@@ -145,20 +156,63 @@ def fleet_size_native(
         ttft=np.zeros(n, np.float64),
         rho=np.zeros(n, np.float64),
     )
-    rc = lib.inferno_fleet_size(
-        n,
-        alpha, d(params.beta), d(params.gamma), d(params.delta),
-        d(params.in_tokens), d(params.out_tokens),
-        i(params.max_batch), i(params.occupancy_cap),
-        d(params.target_ttft), d(params.target_itl), d(params.target_tps),
-        d(params.total_rate), i(params.min_replicas), d(params.cost_per_replica),
-        n_iters, ttft_tail_margin, n_threads,
+    rc = getattr(lib, symbol)(
+        n, *inputs, n_iters, ttft_tail_margin, n_threads,
         out.feasible, out.lambda_star, out.rate_star, out.num_replicas,
         out.cost, out.itl, out.ttft, out.rho,
     )
     if rc != 0:
-        raise RuntimeError(f"inferno_fleet_size failed with code {rc}")
+        raise RuntimeError(f"{symbol} failed with code {rc}")
     return out._replace(feasible=out.feasible.astype(bool))
+
+
+def fleet_size_native(
+    params, n_iters: int = DEFAULT_BISECT_ITERS, n_threads: int = 0,
+    ttft_tail_margin: float | None = None,
+) -> NativeFleetResult:
+    """Size every lane of a FleetParams batch with the C++ solver.
+
+    `params` is any structure with the FleetParams fields (numpy or jax
+    arrays). Semantics match ops.queueing.fleet_size, including the
+    percentile TTFT interpretation (default SLO_MARGIN); precision is f64.
+    """
+    alpha = _d(params.alpha)
+    return _run_sizer(
+        "inferno_fleet_size",
+        (
+            alpha, _d(params.beta), _d(params.gamma), _d(params.delta),
+            _d(params.in_tokens), _d(params.out_tokens),
+            _i(params.max_batch), _i(params.occupancy_cap),
+            _d(params.target_ttft), _d(params.target_itl), _d(params.target_tps),
+            _d(params.total_rate), _i(params.min_replicas),
+            _d(params.cost_per_replica),
+        ),
+        alpha.shape[0], n_iters, ttft_tail_margin, n_threads,
+    )
+
+
+def tandem_size_native(
+    params, n_iters: int = DEFAULT_BISECT_ITERS, n_threads: int = 0,
+    ttft_tail_margin: float | None = None,
+) -> NativeFleetResult:
+    """Size every disaggregated lane of a TandemParams batch with the C++
+    solver. Semantics match ops.queueing.tandem_fleet_size (the batched
+    equivalent of analyzer.disagg); precision is f64."""
+    alpha = _d(params.alpha)
+    return _run_sizer(
+        "inferno_tandem_size",
+        (
+            alpha, _d(params.beta), _d(params.gamma), _d(params.delta),
+            _d(params.in_tokens), _d(params.out_tokens),
+            _i(params.prefill_batch), _i(params.decode_batch),
+            _i(params.prefill_cap), _i(params.decode_cap),
+            _d(params.prefill_slices), _d(params.decode_slices),
+            _d(params.target_ttft), _d(params.target_itl), _d(params.target_tps),
+            _d(params.total_rate), _i(params.min_replicas),
+            _d(params.cost_per_replica),
+        ),
+        alpha.shape[0], n_iters, ttft_tail_margin, n_threads,
+    )
 
 
 __all__ = [
@@ -167,4 +221,5 @@ __all__ = [
     "available",
     "fleet_size_native",
     "load_error",
+    "tandem_size_native",
 ]

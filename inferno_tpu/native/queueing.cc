@@ -67,18 +67,30 @@ double service_time(const Lane& ln, double n) {
 
 double service_rate(const Lane& ln, double n) { return n / service_time(ln, n); }
 
-Grid make_grid(const Lane& ln) {
+// Stage grid for per-request service time t(n) = base + slope * min(n, B)
+// (ops.queueing._make_stage_grid).
+Grid make_stage_grid(double base, double slope, int32_t B, int32_t K) {
   Grid g;
-  g.B = ln.max_batch;
-  g.K = ln.occupancy_cap;
-  g.cml.resize(g.K);
+  g.B = B;
+  g.K = K;
+  g.cml.resize(K);
   double acc = 0.0;
-  for (int32_t k = 1; k <= g.K; ++k) {
-    double n_eff = std::min<double>(k, g.B);
-    acc += std::log(n_eff) - std::log(service_time(ln, n_eff));
+  for (int32_t k = 1; k <= K; ++k) {
+    double n_eff = std::min<double>(k, B);
+    acc += std::log(n_eff) - std::log(base + slope * n_eff);
     g.cml[k - 1] = acc;
   }
   return g;
+}
+
+Grid make_grid(const Lane& ln) {
+  // aggregated lane: prefill and decode folded into one stage
+  // (ops.queueing._agg_base_slope)
+  const double nd = num_decodes(ln);
+  const double base = (ln.in_tokens > 0.0 ? ln.gamma : 0.0) + nd * ln.alpha;
+  const double slope =
+      (ln.in_tokens > 0.0 ? ln.delta * ln.in_tokens : 0.0) + nd * ln.beta;
+  return make_stage_grid(base, slope, ln.max_batch, ln.occupancy_cap);
 }
 
 Stats solve_stats(double lam, const Grid& g) {
@@ -141,10 +153,10 @@ void ttft_itl_at(double lam, const Lane& ln, const Grid& g, double wait_margin,
 
 // Bisection for an increasing metric-of-rate; mirrors
 // ops.queueing._bisect_increasing (reference indicator semantics at
-// pkg/analyzer/utils.go:44-50).
-void bisect(const Lane& ln, const Grid& g, double lam_min, double lam_max,
-            double target, double y_lo, double y_hi, bool use_itl,
-            double wait_margin, int32_t n_iters, double* lam_out,
+// pkg/analyzer/utils.go:44-50). `y_at` maps a rate to the metric value.
+template <typename F>
+void bisect(double lam_min, double lam_max, double target, double y_lo,
+            double y_hi, F&& y_at, int32_t n_iters, double* lam_out,
             bool* ok_out) {
   const bool feasible = target >= y_lo * (1.0 - kFeasSlack);
   if (target >= y_hi) {
@@ -155,10 +167,7 @@ void bisect(const Lane& ln, const Grid& g, double lam_min, double lam_max,
   double lo = lam_min, hi = lam_max;
   for (int32_t i = 0; i < n_iters; ++i) {
     const double mid = 0.5 * (lo + hi);
-    double ttft, itl;
-    ttft_itl_at(mid, ln, g, wait_margin, &ttft, &itl);
-    const double y = use_itl ? itl : ttft;
-    if (y > target)
+    if (y_at(mid) > target)
       hi = mid;
     else
       lo = mid;
@@ -182,11 +191,23 @@ void size_lane(const Lane& ln, int32_t n_iters, double ttft_tail_margin,
   double lam_ttft = lam_max, lam_itl = lam_max;
   bool ok_ttft = true, ok_itl = true;
   if (ln.target_ttft > 0.0)
-    bisect(ln, g, lam_min, lam_max, ln.target_ttft, ttft_lo, ttft_hi, false,
-           ttft_tail_margin, n_iters, &lam_ttft, &ok_ttft);
+    bisect(
+        lam_min, lam_max, ln.target_ttft, ttft_lo, ttft_hi,
+        [&](double lam) {
+          double t, i;
+          ttft_itl_at(lam, ln, g, ttft_tail_margin, &t, &i);
+          return t;
+        },
+        n_iters, &lam_ttft, &ok_ttft);
   if (ln.target_itl > 0.0)
-    bisect(ln, g, lam_min, lam_max, ln.target_itl, itl_lo, itl_hi, true,
-           1.0, n_iters, &lam_itl, &ok_itl);
+    bisect(
+        lam_min, lam_max, ln.target_itl, itl_lo, itl_hi,
+        [&](double lam) {
+          double t, i;
+          ttft_itl_at(lam, ln, g, 1.0, &t, &i);
+          return i;
+        },
+        n_iters, &lam_itl, &ok_itl);
   const double lam_tps =
       ln.target_tps > 0.0 ? lam_max * (1.0 - kStabilitySafety) : lam_max;
 
@@ -215,6 +236,134 @@ void size_lane(const Lane& ln, int32_t n_iters, double ttft_tail_margin,
   *itl_out = ln.alpha + ln.beta * conc;
   *ttft_out = s.wait + prefill;
   *rho = std::clamp(s.in_servers / ln.max_batch, 0.0, 1.0);
+}
+
+// -- disaggregated (prefill/decode tandem) lanes ------------------------------
+//
+// One replica is an atomic unit of prefill + decode engines
+// (JetStream-style). Scalar semantics: inferno_tpu/analyzer/disagg.py;
+// batched equivalent: ops.queueing.tandem_fleet_size. Same math here in
+// double precision so `native` controllers cover disagg variants too.
+
+struct TandemLane {
+  double alpha, beta, gamma, delta;
+  double in_tokens, out_tokens;
+  int32_t prefill_batch, decode_batch;
+  int32_t prefill_cap, decode_cap;
+  double prefill_slices, decode_slices;
+  double target_ttft, target_itl, target_tps;
+  double total_rate;  // req/sec
+  int32_t min_replicas;
+  double cost_per_replica;
+};
+
+double tandem_num_decodes(const TandemLane& ln) {
+  // analyzer.disagg._decode_rates: max(out_tokens - 1, 1)
+  return std::max(ln.out_tokens - 1.0, 1.0);
+}
+
+double stage_concurrency(double serv, double base, double slope, double nmax) {
+  // ops.queueing._stage_concurrency
+  const double numer = serv - base;
+  if (slope <= 0.0) return numer > 0.0 ? nmax : 0.0;
+  return std::clamp(numer / slope, 0.0, nmax);
+}
+
+// TTFT depends only on the prefill stage (DisaggAnalyzer._ttft_at).
+double tandem_ttft_at(double lam_unit, const TandemLane& ln, const Grid& gp,
+                      double wait_margin) {
+  const double p_slope = ln.delta * ln.in_tokens;
+  const Stats p = solve_stats(lam_unit / ln.prefill_slices, gp);
+  const double pconc = stage_concurrency(p.serv, ln.gamma, p_slope, gp.B);
+  return wait_margin * p.wait + ln.gamma + p_slope * pconc;
+}
+
+struct TandemEval {
+  double ttft, itl, rho, tput;  // whole-unit metrics; tput req/msec
+};
+
+TandemEval tandem_eval(double lam_unit, const TandemLane& ln, const Grid& gp,
+                       const Grid& gd) {
+  const double nd = tandem_num_decodes(ln);
+  const double p_slope = ln.delta * ln.in_tokens;
+  const Stats p = solve_stats(lam_unit / ln.prefill_slices, gp);
+  const double pconc = stage_concurrency(p.serv, ln.gamma, p_slope, gp.B);
+
+  // decode stage sees the prefill stage's departures
+  const double through_unit = p.tput * ln.prefill_slices;
+  const Stats d = solve_stats(through_unit / ln.decode_slices, gd);
+  const double dconc = stage_concurrency(d.serv / nd, ln.alpha, ln.beta, gd.B);
+
+  TandemEval e;
+  e.ttft = p.wait + ln.gamma + p_slope * pconc;
+  e.itl = ln.alpha + ln.beta * dconc;
+  e.rho = std::clamp(
+      std::max(p.in_servers / gp.B, d.in_servers / gd.B), 0.0, 1.0);
+  e.tput = d.tput * ln.decode_slices;
+  return e;
+}
+
+void size_tandem_lane(const TandemLane& ln, int32_t n_iters,
+                      double ttft_tail_margin, uint8_t* feasible,
+                      double* lambda_star, double* rate_star,
+                      int32_t* num_replicas, double* cost, double* itl_out,
+                      double* ttft_out, double* rho) {
+  const double nd = tandem_num_decodes(ln);
+  const double p_slope = ln.delta * ln.in_tokens;
+  const Grid gp =
+      make_stage_grid(ln.gamma, p_slope, ln.prefill_batch, ln.prefill_cap);
+  const Grid gd = make_stage_grid(nd * ln.alpha, nd * ln.beta,
+                                  ln.decode_batch, ln.decode_cap);
+
+  // stable range of the whole unit: the binding stage saturates first
+  const double pb = ln.prefill_batch, db = ln.decode_batch;
+  const double mu_p_full = pb / (ln.gamma + p_slope * pb);
+  const double mu_d_full = db / (nd * (ln.alpha + ln.beta * db));
+  const double unit_max =
+      std::min(mu_p_full * ln.prefill_slices, mu_d_full * ln.decode_slices);
+  const double lam_min = unit_max * kRateEps;
+  const double lam_max = unit_max * (1.0 - kRateEps);
+
+  const double ttft_lo = tandem_ttft_at(lam_min, ln, gp, ttft_tail_margin);
+  const double ttft_hi = tandem_ttft_at(lam_max, ln, gp, ttft_tail_margin);
+  const double itl_lo = tandem_eval(lam_min, ln, gp, gd).itl;
+  const double itl_hi = tandem_eval(lam_max, ln, gp, gd).itl;
+
+  double lam_ttft = lam_max, lam_itl = lam_max;
+  bool ok_ttft = true, ok_itl = true;
+  if (ln.target_ttft > 0.0)
+    bisect(
+        lam_min, lam_max, ln.target_ttft, ttft_lo, ttft_hi,
+        [&](double lam) { return tandem_ttft_at(lam, ln, gp, ttft_tail_margin); },
+        n_iters, &lam_ttft, &ok_ttft);
+  if (ln.target_itl > 0.0)
+    bisect(
+        lam_min, lam_max, ln.target_itl, itl_lo, itl_hi,
+        [&](double lam) { return tandem_eval(lam, ln, gp, gd).itl; }, n_iters,
+        &lam_itl, &ok_itl);
+  const double lam_tps =
+      ln.target_tps > 0.0 ? lam_max * (1.0 - kStabilitySafety) : lam_max;
+
+  const double lam_star = std::min({lam_ttft, lam_itl, lam_tps});
+  *feasible = (ok_ttft && ok_itl) ? 1 : 0;
+  *lambda_star = lam_star;
+
+  *rate_star = tandem_eval(lam_star, ln, gp, gd).tput * 1000.0;  // req/sec
+
+  const double total = ln.target_tps > 0.0 ? ln.target_tps / ln.out_tokens
+                                           : ln.total_rate;
+  int32_t replicas = static_cast<int32_t>(std::ceil(total / *rate_star));
+  replicas = std::max(replicas, ln.min_replicas);
+  replicas = std::max(replicas, 1);
+  *num_replicas = replicas;
+  *cost = replicas * ln.cost_per_replica;
+
+  double per_unit = total / replicas / 1000.0;  // req/msec
+  per_unit = std::max(per_unit, lam_min);
+  const TandemEval e = tandem_eval(per_unit, ln, gp, gd);
+  *itl_out = e.itl;
+  *ttft_out = e.ttft;
+  *rho = e.rho;
 }
 
 }  // namespace
@@ -259,6 +408,77 @@ int inferno_fleet_size(
     }
     size_lane(ln, n_iters, ttft_tail_margin, &feasible[i], &lambda_star[i], &rate_star[i],
               &num_replicas[i], &cost[i], &itl[i], &ttft[i], &rho[i]);
+  };
+
+  const int32_t workers =
+      std::max<int32_t>(1, std::min<int32_t>(n_threads, n_lanes));
+  if (workers == 1) {
+    for (int32_t i = 0; i < n_lanes; ++i) run(i);
+    return 0;
+  }
+  std::atomic<int32_t> next{0};
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  for (int32_t w = 0; w < workers; ++w) {
+    pool.emplace_back([&]() {
+      for (int32_t i = next.fetch_add(1); i < n_lanes; i = next.fetch_add(1))
+        run(i);
+    });
+  }
+  for (auto& t : pool) t.join();
+  return 0;
+}
+
+// Disaggregated lanes. Returns 0 on success; all arrays n_lanes elements.
+int inferno_tandem_size(
+    int32_t n_lanes, const double* alpha, const double* beta,
+    const double* gamma, const double* delta, const double* in_tokens,
+    const double* out_tokens, const int32_t* prefill_batch,
+    const int32_t* decode_batch, const int32_t* prefill_cap,
+    const int32_t* decode_cap, const double* prefill_slices,
+    const double* decode_slices, const double* target_ttft,
+    const double* target_itl, const double* target_tps,
+    const double* total_rate, const int32_t* min_replicas,
+    const double* cost_per_replica, int32_t n_iters, double ttft_tail_margin,
+    int32_t n_threads, uint8_t* feasible, double* lambda_star,
+    double* rate_star, int32_t* num_replicas, double* cost, double* itl,
+    double* ttft, double* rho) {
+  if (n_lanes < 0 || n_iters <= 0) return 1;
+  auto run = [&](int32_t i) {
+    TandemLane ln;
+    ln.alpha = alpha[i];
+    ln.beta = beta[i];
+    ln.gamma = gamma[i];
+    ln.delta = delta[i];
+    ln.in_tokens = in_tokens[i];
+    ln.out_tokens = out_tokens[i];
+    ln.prefill_batch = prefill_batch[i];
+    ln.decode_batch = decode_batch[i];
+    ln.prefill_cap = prefill_cap[i];
+    ln.decode_cap = decode_cap[i];
+    ln.prefill_slices = prefill_slices[i];
+    ln.decode_slices = decode_slices[i];
+    ln.target_ttft = target_ttft[i];
+    ln.target_itl = target_itl[i];
+    ln.target_tps = target_tps[i];
+    ln.total_rate = total_rate[i];
+    ln.min_replicas = min_replicas[i];
+    ln.cost_per_replica = cost_per_replica[i];
+    const double nd = tandem_num_decodes(ln);
+    if (ln.prefill_batch <= 0 || ln.decode_batch <= 0 ||
+        ln.prefill_cap < ln.prefill_batch || ln.decode_cap < ln.decode_batch ||
+        ln.prefill_slices < 1.0 || ln.decode_slices < 1.0 ||
+        ln.out_tokens < 1.0 ||
+        ln.gamma + ln.delta * ln.in_tokens <= 0.0 ||
+        nd * (ln.alpha + ln.beta) <= 0.0) {
+      feasible[i] = 0;
+      lambda_star[i] = rate_star[i] = cost[i] = itl[i] = ttft[i] = rho[i] = 0.0;
+      num_replicas[i] = 0;
+      return;
+    }
+    size_tandem_lane(ln, n_iters, ttft_tail_margin, &feasible[i],
+                     &lambda_star[i], &rate_star[i], &num_replicas[i],
+                     &cost[i], &itl[i], &ttft[i], &rho[i]);
   };
 
   const int32_t workers =

@@ -439,16 +439,12 @@ def calculate_fleet(
         return 0
 
     if backend == "native":
-        # the C++ solver covers aggregated lanes (controller deployments
-        # without a TPU attachment); tandem lanes ride the batched XLA
-        # kernel on whatever backend jax has — still one fused program,
-        # never a per-lane Python loop
-        from inferno_tpu.native import fleet_size_native
+        # the C++ solver covers both lane kinds: controller deployments
+        # without a TPU attachment never touch jax on this path
+        from inferno_tpu.native import fleet_size_native, tandem_size_native
 
         result = fleet_size_native(plan.params) if plan is not None else None
-        tresult = (
-            solve_tandem_fleet(tandem, mesh=mesh) if tandem is not None else None
-        )
+        tresult = tandem_size_native(tandem.params) if tandem is not None else None
     else:
         result, tresult = _solve_all(
             plan, tandem, mesh, DEFAULT_BISECT_ITERS, backend == "tpu-pallas"

@@ -167,3 +167,109 @@ def test_calculate_fleet_native_backend():
         for acc, alloc in server.all_allocations.items():
             assert nat[acc].num_replicas == alloc.num_replicas, (name, acc)
             assert nat[acc].cost == pytest.approx(alloc.cost, rel=1e-6)
+
+
+def test_tandem_native_matches_scalar_disagg():
+    """Lane-by-lane parity of the C++ tandem solver vs DisaggAnalyzer
+    through calculate_fleet(backend="native") — the native backend now
+    covers disaggregated variants without touching jax."""
+    from inferno_tpu.config.types import DisaggSpec
+    from fixtures import make_server, make_system_spec
+    from inferno_tpu.core import System
+    from inferno_tpu.parallel import calculate_fleet
+
+    servers = [
+        make_server(name="ns/jet-premium", class_name="Premium", arrival_rate=600.0),
+        make_server(name="ns/jet-freemium", class_name="Freemium",
+                    arrival_rate=2400.0, in_tokens=256, out_tokens=64),
+    ]
+    spec = make_system_spec(servers)
+    for perf in spec.models:
+        if perf.acc == "v5p-8":
+            continue  # mixed fleet: one shape stays aggregated
+        perf.disagg = DisaggSpec(
+            prefill_slices=1, decode_slices=2,
+            prefill_max_batch=8 if perf.acc == "v5e-4" else 0,
+        )
+    sys_native = System(spec)
+    sys_scalar = System(spec)
+    calculate_fleet(sys_native, backend="native")
+    sys_scalar.calculate_all()
+    n_checked = 0
+    for name, server in sys_scalar.servers.items():
+        nat = sys_native.servers[name].all_allocations
+        assert set(nat) == set(server.all_allocations), name
+        for acc, alloc in server.all_allocations.items():
+            got = nat[acc]
+            assert got.batch_size == alloc.batch_size, (name, acc)
+            assert abs(got.num_replicas - alloc.num_replicas) <= 1, (name, acc)
+            assert got.max_arrv_rate_per_replica == pytest.approx(
+                alloc.max_arrv_rate_per_replica, rel=2e-2
+            ), (name, acc)
+            assert got.itl == pytest.approx(alloc.itl, rel=5e-2, abs=0.5)
+            assert got.ttft == pytest.approx(alloc.ttft, rel=5e-2, abs=2.0)
+            assert got.rho == pytest.approx(alloc.rho, rel=5e-2, abs=0.02)
+            # compare per-replica pricing, not total cost: replica counts
+            # may legitimately differ by 1 at a ceil() boundary
+            assert got.cost == pytest.approx(
+                got.num_replicas * alloc.cost / alloc.num_replicas, rel=1e-5
+            )
+            n_checked += 1
+    assert n_checked >= 6
+
+
+def test_tandem_native_matches_xla_kernel():
+    """Raw solver parity: inferno_tandem_size vs ops.queueing's batched
+    tandem kernel on the same TandemParams."""
+    from inferno_tpu.config.types import DisaggSpec
+    from fixtures import make_server, make_system_spec
+    from inferno_tpu.core import System
+    from inferno_tpu.parallel import build_tandem_fleet
+    from inferno_tpu.parallel.fleet import solve_tandem_fleet
+
+    spec = make_system_spec([
+        make_server(name="ns/a", class_name="Premium", arrival_rate=900.0),
+        make_server(name="ns/b", class_name="Freemium", arrival_rate=3000.0,
+                    in_tokens=512, out_tokens=96),
+    ])
+    for perf in spec.models:
+        perf.disagg = DisaggSpec(prefill_slices=2, decode_slices=3)
+    system = System(spec)
+    # candidate scaffolding (normally done inside calculate_fleet)
+    for server in system.servers.values():
+        server.all_allocations = {}
+    plan = build_tandem_fleet(system)
+    assert plan is not None and plan.num_lanes >= 4
+
+    xla = solve_tandem_fleet(plan)
+    nat = native.tandem_size_native(plan.params)
+    np.testing.assert_array_equal(np.asarray(xla.feasible), nat.feasible)
+    for i in range(plan.num_lanes):
+        if not nat.feasible[i]:
+            continue
+        assert nat.rate_star[i] == pytest.approx(
+            float(np.asarray(xla.rate_star)[i]), rel=2e-2
+        )
+        assert abs(int(nat.num_replicas[i]) - int(np.asarray(xla.num_replicas)[i])) <= 1
+        assert nat.itl[i] == pytest.approx(float(np.asarray(xla.itl)[i]), rel=5e-2, abs=0.5)
+        assert nat.ttft[i] == pytest.approx(float(np.asarray(xla.ttft)[i]), rel=5e-2, abs=2.0)
+
+
+def test_tandem_native_invalid_lane_rejected_not_crashing():
+    class P:
+        alpha = np.array([5.0]); beta = np.array([0.1])
+        gamma = np.array([2.0]); delta = np.array([0.01])
+        in_tokens = np.array([128.0]); out_tokens = np.array([64.0])
+        prefill_batch = np.array([0], np.int32)   # invalid
+        decode_batch = np.array([8], np.int32)
+        prefill_cap = np.array([0], np.int32)
+        decode_cap = np.array([88], np.int32)
+        prefill_slices = np.array([1.0]); decode_slices = np.array([1.0])
+        target_ttft = np.array([500.0]); target_itl = np.array([24.0])
+        target_tps = np.array([0.0]); total_rate = np.array([10.0])
+        min_replicas = np.array([1], np.int32)
+        cost_per_replica = np.array([40.0])
+
+    out = native.tandem_size_native(P())
+    assert not out.feasible[0]
+    assert out.num_replicas[0] == 0
