@@ -65,6 +65,13 @@ def _load() -> ctypes.CDLL | None:
             if stale:
                 _build()
             lib = ctypes.CDLL(_LIB)
+            if not hasattr(lib, "inferno_tandem_size"):
+                # a prebuilt .so from before a symbol was added can carry a
+                # newer mtime than the source (image layers don't preserve
+                # build order): rebuild from the source sitting next to it
+                # rather than disabling the whole backend
+                _build()
+                lib = ctypes.CDLL(_LIB)
             fn = lib.inferno_fleet_size
             fn.restype = ctypes.c_int
             fn.argtypes = [

@@ -366,6 +366,27 @@ void size_tandem_lane(const TandemLane& ln, int32_t n_iters,
   *rho = e.rho;
 }
 
+// Shared worker-pool dispatch: run(i) over lanes, serial when one worker.
+template <typename F>
+void for_each_lane(int32_t n_lanes, int32_t n_threads, F&& run) {
+  const int32_t workers =
+      std::max<int32_t>(1, std::min<int32_t>(n_threads, n_lanes));
+  if (workers == 1) {
+    for (int32_t i = 0; i < n_lanes; ++i) run(i);
+    return;
+  }
+  std::atomic<int32_t> next{0};
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  for (int32_t w = 0; w < workers; ++w) {
+    pool.emplace_back([&]() {
+      for (int32_t i = next.fetch_add(1); i < n_lanes; i = next.fetch_add(1))
+        run(i);
+    });
+  }
+  for (auto& t : pool) t.join();
+}
+
 }  // namespace
 
 extern "C" {
@@ -400,7 +421,8 @@ int inferno_fleet_size(
     ln.min_replicas = min_replicas[i];
     ln.cost_per_replica = cost_per_replica[i];
     if (ln.max_batch <= 0 || ln.occupancy_cap < ln.max_batch ||
-        ln.out_tokens < 1.0 || service_time(ln, 1.0) <= 0.0) {
+        ln.out_tokens < 1.0 || service_time(ln, 1.0) <= 0.0 ||
+        service_time(ln, ln.max_batch) <= 0.0) {
       feasible[i] = 0;
       lambda_star[i] = rate_star[i] = cost[i] = itl[i] = ttft[i] = rho[i] = 0.0;
       num_replicas[i] = 0;
@@ -410,22 +432,7 @@ int inferno_fleet_size(
               &num_replicas[i], &cost[i], &itl[i], &ttft[i], &rho[i]);
   };
 
-  const int32_t workers =
-      std::max<int32_t>(1, std::min<int32_t>(n_threads, n_lanes));
-  if (workers == 1) {
-    for (int32_t i = 0; i < n_lanes; ++i) run(i);
-    return 0;
-  }
-  std::atomic<int32_t> next{0};
-  std::vector<std::thread> pool;
-  pool.reserve(workers);
-  for (int32_t w = 0; w < workers; ++w) {
-    pool.emplace_back([&]() {
-      for (int32_t i = next.fetch_add(1); i < n_lanes; i = next.fetch_add(1))
-        run(i);
-    });
-  }
-  for (auto& t : pool) t.join();
+  for_each_lane(n_lanes, n_threads, run);
   return 0;
 }
 
@@ -470,7 +477,9 @@ int inferno_tandem_size(
         ln.prefill_slices < 1.0 || ln.decode_slices < 1.0 ||
         ln.out_tokens < 1.0 ||
         ln.gamma + ln.delta * ln.in_tokens <= 0.0 ||
-        nd * (ln.alpha + ln.beta) <= 0.0) {
+        ln.gamma + ln.delta * ln.in_tokens * ln.prefill_batch <= 0.0 ||
+        nd * (ln.alpha + ln.beta) <= 0.0 ||
+        nd * (ln.alpha + ln.beta * ln.decode_batch) <= 0.0) {
       feasible[i] = 0;
       lambda_star[i] = rate_star[i] = cost[i] = itl[i] = ttft[i] = rho[i] = 0.0;
       num_replicas[i] = 0;
@@ -481,22 +490,7 @@ int inferno_tandem_size(
                      &cost[i], &itl[i], &ttft[i], &rho[i]);
   };
 
-  const int32_t workers =
-      std::max<int32_t>(1, std::min<int32_t>(n_threads, n_lanes));
-  if (workers == 1) {
-    for (int32_t i = 0; i < n_lanes; ++i) run(i);
-    return 0;
-  }
-  std::atomic<int32_t> next{0};
-  std::vector<std::thread> pool;
-  pool.reserve(workers);
-  for (int32_t w = 0; w < workers; ++w) {
-    pool.emplace_back([&]() {
-      for (int32_t i = next.fetch_add(1); i < n_lanes; i = next.fetch_add(1))
-        run(i);
-    });
-  }
-  for (auto& t : pool) t.join();
+  for_each_lane(n_lanes, n_threads, run);
   return 0;
 }
 

@@ -439,8 +439,8 @@ def calculate_fleet(
         return 0
 
     if backend == "native":
-        # the C++ solver covers both lane kinds: controller deployments
-        # without a TPU attachment never touch jax on this path
+        # the C++ solver covers both lane kinds: no device runtime and no
+        # XLA compilation on this path (jax stays a host-only import)
         from inferno_tpu.native import fleet_size_native, tandem_size_native
 
         result = fleet_size_native(plan.params) if plan is not None else None

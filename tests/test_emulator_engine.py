@@ -1,0 +1,146 @@
+"""Emulated-engine internals: KV admission control, batching bounds, the
+virtual clock, and the quadratic (non-linear) profile knob.
+
+The analogue of the reference emulator-core behaviors
+(/root/reference/tools/vllm-emulator/vllm_model.py:254-467 — KV-memory
+admission, waiting/running queues, decode-step clock).
+"""
+
+import time
+
+import pytest
+
+from inferno_tpu.emulator.engine import EmulatedEngine, EngineProfile
+
+FAST = EngineProfile(alpha=1.0, beta=0.05, gamma=0.5, delta=0.001, max_batch=4)
+SCALE = 0.002
+
+
+def drain(engine, reqs, timeout=30.0):
+    for r in reqs:
+        assert r.done_event.wait(timeout), "request did not complete"
+
+
+def test_kv_admission_blocks_oversized_working_set():
+    """Requests whose KV footprint exceeds capacity must wait even when
+    batch slots are free."""
+    # slow steps (~0.4ms wall each) so the admission state is observable
+    # long before the ~80ms first completions
+    prof = EngineProfile(alpha=20.0, beta=0.5, gamma=1.0, delta=0.001,
+                         max_batch=8, kv_tokens_capacity=1000)
+    eng = EmulatedEngine(prof, time_scale=0.02)
+    eng.start()
+    try:
+        # each request needs 400 in + 200 out = 600 KV tokens: only 1 fits
+        # fully, a second fits while outputs are short -> never more than 2
+        reqs = [eng.submit(400, 200) for _ in range(4)]
+        time.sleep(0.03)
+        assert eng.num_running <= 2
+        assert eng.num_waiting >= 2
+        assert eng.kv_used_fraction() <= 1.0
+        drain(eng, reqs)  # waiters admitted as completions free KV
+    finally:
+        eng.stop()
+
+
+def test_kv_admission_is_fifo_head_blocking():
+    """A head-of-line request that does not fit blocks the queue (matching
+    the reference's in-order admission) rather than being skipped."""
+    prof = EngineProfile(alpha=20.0, beta=0.5, gamma=1.0, delta=0.001,
+                         max_batch=8, kv_tokens_capacity=1000)
+    eng = EmulatedEngine(prof, time_scale=0.02)
+    eng.start()
+    try:
+        big = eng.submit(900, 50)     # takes nearly all KV for ~20ms wall
+        time.sleep(0.005)
+        huge = eng.submit(800, 100)   # fits alone, can never co-run with `big`
+        small = eng.submit(10, 10)    # would fit, but queued behind `huge`
+        time.sleep(0.01)
+        assert eng.num_running == 1   # only `big`
+        assert eng.num_waiting == 2
+        drain(eng, [big, huge, small])
+    finally:
+        eng.stop()
+
+
+def test_batch_never_exceeds_max_batch():
+    eng = EmulatedEngine(FAST, time_scale=SCALE)
+    eng.start()
+    try:
+        reqs = [eng.submit(8, 64) for _ in range(16)]
+        peak = 0
+        deadline = time.time() + 10.0
+        while any(not r.done_event.is_set() for r in reqs) and time.time() < deadline:
+            peak = max(peak, eng.num_running)
+            time.sleep(0.005)
+        drain(eng, reqs)
+        assert peak <= FAST.max_batch
+        assert peak >= 2  # concurrency actually happened
+    finally:
+        eng.stop()
+
+
+def test_virtual_clock_advances_with_steps_and_idle():
+    eng = EmulatedEngine(FAST, time_scale=SCALE)
+    eng.start()
+    try:
+        time.sleep(0.05)
+        idle_ms = eng.emu_ms
+        assert idle_ms > 0  # idle ticks keep the clock moving
+        r = eng.submit(16, 32)
+        assert r.done_event.wait(10)
+        # 32 decode steps at >= alpha ms each, plus prefill
+        assert eng.emu_ms >= idle_ms + 32 * FAST.alpha
+    finally:
+        eng.stop()
+
+
+def test_latencies_scale_with_emulated_profile():
+    """Emulated TTFT/latency reflect the profile's terms, not wall-clock
+    noise: doubled output length ~doubles decode time."""
+    eng = EmulatedEngine(FAST, time_scale=SCALE)
+    eng.start()
+    try:
+        a = eng.generate(16, 16, timeout=10)
+        b = eng.generate(16, 64, timeout=10)
+        assert a is not None and b is not None
+        decode_a = a.latency_ms - a.ttft_ms
+        decode_b = b.latency_ms - b.ttft_ms
+        assert decode_b == pytest.approx(decode_a * (63 / 15), rel=0.25)
+    finally:
+        eng.stop()
+
+
+def test_quadratic_beta2_bends_itl_superlinearly():
+    """The beta2 knob exists so closed-loop tests can emulate true
+    profiles the CR's linear alpha/beta cannot capture (the corrector
+    scenario). Full-batch ITL must exceed the linear prediction."""
+    linear = EngineProfile(alpha=2.0, beta=0.1, gamma=0.5, delta=0.001,
+                           max_batch=8)
+    bent = EngineProfile(alpha=2.0, beta=0.1, gamma=0.5, delta=0.001,
+                         max_batch=8, beta2=0.2)
+
+    def full_batch_itl(prof):
+        eng = EmulatedEngine(prof, time_scale=SCALE)
+        eng.start()
+        try:
+            reqs = [eng.submit(8, 32) for _ in range(8)]
+            drain(eng, reqs)
+            comps = [r for _, r in eng.completions]
+            return sum(
+                (c.latency_emu_ms - c.ttft_emu_ms) / max(c.out_tokens - 1, 1)
+                for c in comps
+            ) / len(comps)
+        finally:
+            eng.stop()
+
+    itl_linear = full_batch_itl(linear)
+    itl_bent = full_batch_itl(bent)
+    # beta2 * batch^2 = 0.2 * 64 = 12.8ms extra per step at batch 8
+    assert itl_bent > itl_linear + 5.0
+
+
+def test_completion_telemetry_windows_bounded():
+    eng = EmulatedEngine(FAST, time_scale=SCALE)
+    assert eng.completions.maxlen == 100_000
+    assert eng.arrivals.maxlen == 100_000

@@ -273,3 +273,43 @@ def test_tandem_native_invalid_lane_rejected_not_crashing():
     out = native.tandem_size_native(P())
     assert not out.feasible[0]
     assert out.num_replicas[0] == 0
+
+
+def test_negative_slope_at_full_batch_rejected():
+    """alpha+beta>0 but alpha+beta*batch<=0 (negative slope) must be
+    rejected per lane, not produce NaN/feasible=1 through the C ABI."""
+    def agg_params(beta):
+        class P:
+            alpha = np.array([10.0]); beta_ = None
+            gamma = np.array([2.0]); delta = np.array([0.01])
+            in_tokens = np.array([128.0]); out_tokens = np.array([64.0])
+            max_batch = np.array([8], np.int32)
+            occupancy_cap = np.array([88], np.int32)
+            target_ttft = np.array([500.0]); target_itl = np.array([24.0])
+            target_tps = np.array([0.0]); total_rate = np.array([10.0])
+            min_replicas = np.array([1], np.int32)
+            cost_per_replica = np.array([40.0])
+        P.beta = np.array([beta])
+        return P()
+
+    out = native.fleet_size_native(agg_params(-2.0))
+    assert not out.feasible[0] and out.num_replicas[0] == 0
+    assert np.isfinite(out.ttft[0]) and np.isfinite(out.itl[0])
+
+    class T:
+        alpha = np.array([10.0]); beta = np.array([-2.0])
+        gamma = np.array([2.0]); delta = np.array([0.01])
+        in_tokens = np.array([128.0]); out_tokens = np.array([64.0])
+        prefill_batch = np.array([8], np.int32)
+        decode_batch = np.array([8], np.int32)
+        prefill_cap = np.array([88], np.int32)
+        decode_cap = np.array([88], np.int32)
+        prefill_slices = np.array([1.0]); decode_slices = np.array([2.0])
+        target_ttft = np.array([500.0]); target_itl = np.array([24.0])
+        target_tps = np.array([0.0]); total_rate = np.array([10.0])
+        min_replicas = np.array([1], np.int32)
+        cost_per_replica = np.array([40.0])
+
+    tout = native.tandem_size_native(T())
+    assert not tout.feasible[0] and tout.num_replicas[0] == 0
+    assert np.isfinite(tout.ttft[0]) and np.isfinite(tout.itl[0])
