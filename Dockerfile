@@ -10,9 +10,9 @@ RUN apt-get update && apt-get install -y --no-install-recommends g++ \
 WORKDIR /src
 COPY pyproject.toml README.md ./
 COPY inferno_tpu ./inferno_tpu
-RUN g++ -O3 -std=c++17 -shared -fPIC \
-      -o inferno_tpu/native/libinferno_queueing.so \
-      inferno_tpu/native/queueing.cc -pthread \
+RUN python -c "import sys; sys.path.insert(0, '.'); \
+      from inferno_tpu import native; \
+      assert native.available(), native.load_error()" \
     && pip install --no-cache-dir build && python -m build --wheel
 
 FROM python:3.12-slim

@@ -37,9 +37,9 @@ bench:
 
 # Build the native C++ solver in place (also built on demand at import).
 native:
-	g++ -O3 -std=c++17 -shared -fPIC \
-	  -o inferno_tpu/native/libinferno_queueing.so \
-	  inferno_tpu/native/queueing.cc -pthread
+	python -c "from inferno_tpu import native; \
+	  assert native.available(), native.load_error(); \
+	  print('native solver built:', native._lib_path())"
 
 lint:
 	$(PYTHON) -m compileall -q inferno_tpu tests

@@ -189,9 +189,12 @@ def solve_birth_death(lam: float, serv_rates_arr: np.ndarray, occupancy_cap: int
 
     k = np.arange(k_cap + 1, dtype=np.float64)
     avg_in_system = float(np.sum(k * p))
-    in_serv_mass = float(np.sum(p[: n_serv + 1]))
-    avg_in_servers = float(np.sum(k[1 : n_serv + 1] * p[1 : n_serv + 1])) + n_serv * (
-        1.0 - in_serv_mass
+    # queue mass summed directly, not as 1 - (mass in service): the
+    # complement is rounding residue at low load and n_serv amplifies it
+    # (decisive in the f32 kernels, ops/queueing.py; kept identical here)
+    queue_mass = float(np.sum(p[n_serv + 1 :]))
+    avg_in_servers = (
+        float(np.sum(k[1 : n_serv + 1] * p[1 : n_serv + 1])) + n_serv * queue_mass
     )
     throughput = lam * (1.0 - float(p[k_cap]))
     avg_resp = avg_in_system / throughput

@@ -15,6 +15,7 @@ callers fall back to the scalar analyzer when it is not.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -24,7 +25,17 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "queueing.cc")
-_LIB = os.path.join(_DIR, "libinferno_queueing.so")
+
+
+def _lib_path() -> str:
+    """Content-addressed artifact path: the library name embeds the source
+    hash, so a changed queueing.cc can never be satisfied by a stale
+    prebuilt .so — and a rebuild loads from a fresh path (dlopen caches
+    handles by pathname, so reloading the SAME path after a rebuild would
+    silently return the old library)."""
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_DIR, f"libinferno_queueing-{digest}.so")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -37,7 +48,7 @@ _U8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 DEFAULT_BISECT_ITERS = 64  # double precision; deeper than the f32 TPU kernel
 
 
-def _build() -> None:
+def _build(lib_path: str) -> None:
     cmd = [
         "g++",
         "-O3",
@@ -45,7 +56,7 @@ def _build() -> None:
         "-shared",
         "-fPIC",
         "-o",
-        _LIB,
+        lib_path,
         _SRC,
         "-pthread",
     ]
@@ -58,20 +69,10 @@ def _load() -> ctypes.CDLL | None:
         if _lib is not None or _load_error is not None:
             return _lib
         try:
-            stale = (
-                not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
-            )
-            if stale:
-                _build()
-            lib = ctypes.CDLL(_LIB)
-            if not hasattr(lib, "inferno_tandem_size"):
-                # a prebuilt .so from before a symbol was added can carry a
-                # newer mtime than the source (image layers don't preserve
-                # build order): rebuild from the source sitting next to it
-                # rather than disabling the whole backend
-                _build()
-                lib = ctypes.CDLL(_LIB)
+            lib_path = _lib_path()
+            if not os.path.exists(lib_path):
+                _build(lib_path)
+            lib = ctypes.CDLL(lib_path)
             fn = lib.inferno_fleet_size
             fn.restype = ctypes.c_int
             fn.argtypes = [

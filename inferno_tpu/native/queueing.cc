@@ -102,21 +102,22 @@ Stats solve_stats(double lam, const Grid& g) {
 
   double z = std::exp(-m);          // state 0
   double sum_k = 0.0;               // sum k * w
-  double mass_le_b = std::exp(-m);  // states k <= B
+  double mass_gt_b = 0.0;           // states k > B, summed directly
   double sum_k_le_b = 0.0;
   double w_cap = 0.0;               // state K
   for (int32_t k = 1; k <= g.K; ++k) {
     double w = std::exp(k * loglam - g.cml[k - 1] - m);
     z += w;
     sum_k += k * w;
-    if (k <= g.B) {
-      mass_le_b += w;
+    if (k <= g.B)
       sum_k_le_b += k * w;
-    }
+    else
+      mass_gt_b += w;  // never 1 - mass_le_b: the complement cancels at
+                       // low load and B amplifies the rounding residue
     if (k == g.K) w_cap = w;
   }
   const double in_system = sum_k / z;
-  const double in_servers = sum_k_le_b / z + g.B * (1.0 - mass_le_b / z);
+  const double in_servers = sum_k_le_b / z + g.B * (mass_gt_b / z);
   const double p_block = w_cap / z;
   const double tput = lam * (1.0 - p_block);
   const double resp = in_system / tput;

@@ -54,10 +54,12 @@ def _stats_kernel(cml_ref, lam_ref, nmax_ref, cap_ref, out_ref):
 
     le_n = kk <= nmax
     ke = kk * e
-    mass_le_n = (p0 + jnp.sum(jnp.where(le_n, e, 0.0), axis=1, keepdims=True)) / z
+    # queue mass summed directly (never 1 - mass_le_n: the complement is
+    # f32 rounding noise at low load, amplified by nmax — see ops.queueing)
+    mass_gt_n = jnp.sum(jnp.where(le_n, 0.0, e), axis=1, keepdims=True) / z
     in_servers = (
         jnp.sum(jnp.where(le_n, ke, 0.0), axis=1, keepdims=True) / z
-        + nmax * (1.0 - mass_le_n)
+        + nmax * mass_gt_n
     )
     # queue length directly as sum_{k>n} (k-n) p[k]: avoids the f32
     # cancellation of the in_system - in_servers formulation

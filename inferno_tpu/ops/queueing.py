@@ -148,9 +148,13 @@ def _solve_stats(lam: jax.Array, grid: _Grid):
     prob = jnp.exp(logp - logz)
 
     in_system = jnp.sum(grid.kk * prob, axis=1)
-    mass_le_n = jnp.sum(jnp.where(grid.le_n, prob, 0.0), axis=1)
+    # queue mass summed DIRECTLY (not 1 - mass_le_n): at low load the
+    # complement is pure f32 rounding noise (~1e-6 on TPU transcendentals)
+    # that nmax amplifies into a visible service-time error — large enough
+    # to flip SLO feasibility at the lam_min probe (seen on real v5e)
+    mass_gt_n = jnp.sum(jnp.where(grid.le_n, 0.0, prob), axis=1)
     in_servers = jnp.sum(jnp.where(grid.le_n, grid.kk * prob, 0.0), axis=1) + (
-        grid.nmax * (1.0 - mass_le_n)
+        grid.nmax * mass_gt_n
     )
     p_block = jnp.take_along_axis(prob, grid.cap_idx, axis=1)[:, 0]
     throughput = lam * (1.0 - p_block)

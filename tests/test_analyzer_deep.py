@@ -278,3 +278,22 @@ def test_single_token_requests_are_sizable():
     rates, metrics, _ = an.size(TargetPerf(target_itl=60.0))
     assert rates.rate_target_itl > 0
     assert metrics.throughput > 0
+
+
+def test_low_load_service_time_exact_on_large_grids():
+    """Regression (found on real v5e): in_servers must sum the queue mass
+    directly, never as nmax*(1 - mass_in_service) — at low load the
+    complement is floating-point residue that nmax amplifies; in the f32
+    kernels it inflated service time ~35% and flipped SLO feasibility.
+    All four backends share the formulation now; this pins the scalar
+    semantics at a tolerance the subtractive form cannot meet in f32."""
+    dec = DecodeParms(alpha=18.0, beta=0.3)
+    pre = PrefillParms(gamma=5.0, delta=0.02)
+    req = RequestSize(avg_in_tokens=64, avg_out_tokens=32)
+    mu = service_rates(dec, pre, req, max_batch=256)
+    lam = float(mu[0]) * 1e-3  # the lam_min probe
+    s = solve_birth_death(lam, mu, 2816)
+    t1 = prefill_time(pre, 64, 1.0) + 31 * decode_time(dec, 1.0)
+    # tiny genuine mass sits at n=2 (rel ~2e-5); the subtractive-form bug
+    # was a 35% error, so 1e-4 discriminates with orders to spare
+    assert s.avg_serv_time == pytest.approx(t1, rel=1e-4)

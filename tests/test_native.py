@@ -280,7 +280,7 @@ def test_negative_slope_at_full_batch_rejected():
     rejected per lane, not produce NaN/feasible=1 through the C ABI."""
     def agg_params(beta):
         class P:
-            alpha = np.array([10.0]); beta_ = None
+            alpha = np.array([10.0])
             gamma = np.array([2.0]); delta = np.array([0.01])
             in_tokens = np.array([128.0]); out_tokens = np.array([64.0])
             max_batch = np.array([8], np.int32)
