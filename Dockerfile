@@ -10,10 +10,11 @@ RUN apt-get update && apt-get install -y --no-install-recommends g++ \
 WORKDIR /src
 COPY pyproject.toml README.md ./
 COPY inferno_tpu ./inferno_tpu
-RUN python -c "import sys; sys.path.insert(0, '.'); \
+RUN pip install --no-cache-dir numpy build \
+    && python -c "import sys; sys.path.insert(0, '.'); \
       from inferno_tpu import native; \
       assert native.available(), native.load_error()" \
-    && pip install --no-cache-dir build && python -m build --wheel
+    && python -m build --wheel
 
 FROM python:3.12-slim
 RUN useradd --uid 65532 --create-home nonroot

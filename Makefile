@@ -37,7 +37,7 @@ bench:
 
 # Build the native C++ solver in place (also built on demand at import).
 native:
-	python -c "from inferno_tpu import native; \
+	$(PYTHON) -c "from inferno_tpu import native; \
 	  assert native.available(), native.load_error(); \
 	  print('native solver built:', native._lib_path())"
 
@@ -66,5 +66,5 @@ undeploy:
 	kubectl delete -k deploy/manifests --ignore-not-found=true
 
 clean:
-	rm -f inferno_tpu/native/libinferno_queueing.so
+	rm -f inferno_tpu/native/libinferno_queueing*.so
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -49,6 +49,16 @@ DEFAULT_BISECT_ITERS = 64  # double precision; deeper than the f32 TPU kernel
 
 
 def _build(lib_path: str) -> None:
+    # drop superseded hashed artifacts so dev trees / wheels don't
+    # accumulate dead libraries (the *.so package-data glob ships them)
+    import glob
+
+    for old in glob.glob(os.path.join(_DIR, "libinferno_queueing*.so")):
+        if old != lib_path:
+            try:
+                os.remove(old)
+            except OSError:
+                pass
     cmd = [
         "g++",
         "-O3",

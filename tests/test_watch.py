@@ -251,3 +251,73 @@ def test_watch_stream_survives_unexpected_exception():
     assert t.is_alive()
     assert len(calls) >= 2  # retried after the unexpected exception
     w.stop()
+
+
+def test_bookmark_refreshes_rv_without_waking():
+    """BOOKMARK events keep resourceVersion fresh across quiet periods but
+    must not trigger reconciles; garbage lines are skipped; the rv carried
+    into the NEXT watch is the newest seen mid-stream (the MODIFIED
+    event's 100, not the listed 41)."""
+    events = [
+        {"type": "BOOKMARK", "object": {"metadata": {"resourceVersion": "99"}}},
+    ]
+    srv = _StreamingWatchServer(events)
+    # interleave a malformed line by monkeypatching the event list with a
+    # sentinel the server writes verbatim
+    srv.events = [
+        {"type": "BOOKMARK", "object": {"metadata": {"resourceVersion": "99"}}},
+        "this is not json",
+        {"type": "MODIFIED", "object": {"kind": "VariantAutoscaling",
+                                        "metadata": {"resourceVersion": "100"}}},
+    ]
+
+    # the fake server json.dumps each event; emit the garbage raw instead
+    real_dumps = json.dumps
+
+    def dumps(obj, *a, **k):
+        if isinstance(obj, str):
+            return obj  # write the malformed line as-is
+        return real_dumps(obj, *a, **k)
+
+    woke = []
+    w = Watcher(_FakeRestKube(f"http://127.0.0.1:{srv.port}"),
+                lambda: woke.append(1), config_namespace=CFG_NS)
+    import unittest.mock as mock
+
+    with mock.patch("test_watch.json.dumps", side_effect=dumps):
+        t = threading.Thread(target=w._run_va_stream, daemon=True)
+        t.start()
+        assert srv.done.wait(5)
+        # wait for the RECONNECT watch request carrying the updated rv
+        deadline = time.time() + 5
+        while len(srv.watch_requests) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+    w.stop()
+    srv.stop()
+    assert woke == []  # neither BOOKMARK, garbage, nor MODIFIED wake
+    assert len(srv.watch_requests) >= 2
+    # reconnect resumed from the newest rv seen mid-stream (100), so no
+    # replay of older events
+    assert "resourceVersion=100" in srv.watch_requests[1]
+
+
+def test_cm_event_namespace_filter():
+    """A watched ConfigMap name in the WRONG namespace must not wake."""
+    woke = []
+    w = Watcher(object(), lambda: woke.append(1), config_namespace=CFG_NS)
+    w._on_cm_event(WATCHED_CONFIGMAPS[0], "elsewhere")
+    assert woke == []
+    w._on_cm_event(WATCHED_CONFIGMAPS[0], CFG_NS)
+    assert woke == [1]
+    w._on_cm_event("unwatched-cm", CFG_NS)
+    assert woke == [1]
+
+
+def test_va_event_type_filter():
+    woke = []
+    w = Watcher(object(), lambda: woke.append(1), config_namespace=CFG_NS)
+    for t in ("MODIFIED", "DELETED", "BOOKMARK", "ERROR", ""):
+        w._on_va_event(t)
+    assert woke == []
+    w._on_va_event("ADDED")
+    assert woke == [1]
