@@ -30,6 +30,10 @@ controller.go:516-582):
                                 recalibration of CR perf profiles —
                                 models/corrector.py; false = reference-
                                 exact static profiles)
+  KEEP_ACCELERATOR              true|false (default true, reference-exact
+                                pin of each variant to its current slice
+                                shape; false allows economic migration
+                                between shapes)
 """
 
 from __future__ import annotations
@@ -120,6 +124,7 @@ def main() -> int:
         ).lower(),
         direct_scale=env_bool("DIRECT_SCALE"),
         profile_correction=env_bool("PROFILE_CORRECTION", True),
+        keep_accelerator=env_bool("KEEP_ACCELERATOR", True),
     )
     rec = Reconciler(kube=kube, prom=prom, config=config, emitter=emitter)
 

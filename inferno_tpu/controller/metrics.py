@@ -54,6 +54,9 @@ class _Series:
         v = self.values.get(self._key(labels))
         return v[1] if v else None
 
+    def remove(self, labels: dict[str, str]) -> None:
+        self.values.pop(self._key(labels), None)
+
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} {self.kind}"
@@ -94,6 +97,8 @@ class MetricsEmitter:
 
     def __init__(self, registry: Registry | None = None):
         self.registry = registry or Registry()
+        # (namespace, variant) -> accelerator of the last emission
+        self._last_accelerator: dict[tuple[str, str], str] = {}
         self.scaling_total = self.registry.counter(
             METRIC_SCALING_TOTAL, "Replica scaling decisions by direction"
         )
@@ -121,6 +126,17 @@ class MetricsEmitter:
             LABEL_VARIANT: variant,
             LABEL_ACCELERATOR: accelerator,
         }
+        # A shape migration (KEEP_ACCELERATOR=false) re-keys the variant's
+        # series by accelerator; the old-shape gauges must be dropped or
+        # HPA/adapter queries that aggregate over the variant keep reading
+        # stale values forever.
+        prev = self._last_accelerator.get((namespace, variant))
+        if prev is not None and prev != accelerator:
+            old = {**labels, LABEL_ACCELERATOR: prev}
+            for series in (self.desired_replicas, self.current_replicas,
+                           self.desired_ratio):
+                series.remove(old)
+        self._last_accelerator[(namespace, variant)] = accelerator
         self.desired_replicas.set(labels, float(desired))
         self.current_replicas.set(labels, float(current))
         # scale-from-zero: ratio encodes the absolute target

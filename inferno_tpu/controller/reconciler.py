@@ -85,12 +85,30 @@ class ReconcilerConfig:
                 f"compute_backend must be tpu|tpu-pallas|native|scalar, "
                 f"got {self.compute_backend!r}"
             )
+        if not self.keep_accelerator and self.direct_scale:
+            # direct_scale only patches replica counts on the EXISTING
+            # workload; it cannot re-provision pods onto a different slice
+            # shape, so a migration decision would be actuated as a bare
+            # scale-down on the old hardware — a guaranteed SLO breach.
+            # Shape migration needs an external actuator that watches
+            # desiredOptimizedAlloc.accelerator (HPA/KEDA + llm-d infra).
+            raise ValueError(
+                "KEEP_ACCELERATOR=false is incompatible with DIRECT_SCALE=true: "
+                "direct scaling cannot re-provision a variant onto a different "
+                "slice shape"
+            )
     direct_scale: bool = False  # actuate Deployments directly (no HPA)
     interval_seconds: int = DEFAULT_INTERVAL_SECONDS
     # calibrate CR-carried linear profiles against observed telemetry,
     # consulting the learned surrogate where residuals are large
     # (models/corrector.py); disable for reference-exact static profiles
     profile_correction: bool = True
+    # pin each variant to its current slice shape across cycles (the
+    # reference hardcodes this, utils.go:290). False lets the optimizer
+    # MIGRATE variants between shapes when the economics demand it —
+    # expect churn tolerance from the serving stack (a shape change
+    # re-provisions every pod-slice of the variant)
+    keep_accelerator: bool = True
 
 
 @dataclasses.dataclass
@@ -460,7 +478,10 @@ class Reconciler:
                 name=va.full_name,
                 class_name=class_name,
                 model=model_key,
-                keep_accelerator=True,  # pinned across cycles (utils.go:290)
+                # pinned across cycles by default (the reference hardcodes
+                # this, utils.go:290); KEEP_ACCELERATOR=false enables
+                # economic migration between slice shapes
+                keep_accelerator=self.config.keep_accelerator,
                 min_num_replicas=min_replicas,
                 current_alloc=AllocationData(
                     accelerator=current.accelerator,

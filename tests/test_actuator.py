@@ -165,3 +165,26 @@ def test_exposition_renders_all_series():
     assert "inferno_current_replicas" in text
     assert "inferno_desired_ratio" in text
     assert 'variant_name="llama"' in text
+
+
+def test_shape_migration_drops_old_accelerator_series():
+    """A migration re-keys the variant's gauges by accelerator; the
+    old-shape series must disappear or adapter queries aggregating over
+    the variant read stale values forever."""
+    cluster = InMemoryCluster()
+    cluster.add_deployment(NS, "llama", replicas=2)
+    emitter = MetricsEmitter()
+    act = Actuator(kube=cluster, emitter=emitter)
+    act.emit_metrics(make_va(desired=3, acc="v5e-4"))
+    assert emitter.desired_replicas.get(labels("v5e-4")) == 3.0
+
+    act.emit_metrics(make_va(desired=1, acc="v5e-16"))
+    assert emitter.desired_replicas.get(labels("v5e-16")) == 1.0
+    for series in (emitter.desired_replicas, emitter.current_replicas,
+                   emitter.desired_ratio):
+        assert series.get(labels("v5e-4")) is None
+    # no GAUGE line still carries the old shape (the scaling counter keeps
+    # its history — counters are cumulative by contract)
+    for line in emitter.registry.render().splitlines():
+        if 'accelerator="v5e-4"' in line:
+            assert line.startswith("inferno_replica_scaling_total"), line

@@ -295,3 +295,79 @@ def test_exposition_parser():
     series = parse_exposition(text)
     assert ("x", {"a": "1", "b": "two"}, 3.5) in series
     assert ("plain", {}, 7.0) in series
+
+
+def test_shape_pinning_and_economic_migration():
+    """Heterogeneous slice economics through the full loop. Default
+    (KEEP_ACCELERATOR=true, reference-exact pin of utils.go:290): the
+    variant scales out on its current shape even when another shape is
+    far cheaper for the load. With the pin off, the optimizer MIGRATES
+    the variant to v5e-16 — whose barely-SLO-feasible little sibling
+    serves ~1/50th the rate at 1/4 the price — and returns to the cheap
+    shape at idle (the transition penalty shapes the objective but never
+    outweighs a 4x running-cost gap)."""
+    from inferno_tpu.config.types import DecodeParms, PrefillParms
+    from inferno_tpu.controller.crd import (
+        ACCELERATOR_LABEL,
+        AcceleratorProfile,
+        ConfigMapKeyRef,
+        VariantAutoscaling,
+        VariantAutoscalingSpec,
+    )
+    from test_controller import make_prom
+
+    cluster = make_cluster(replicas=1)
+    cluster.delete_variant_autoscaling(NS, "llama-premium")
+    va = VariantAutoscaling(
+        name="llama-premium",
+        namespace=NS,
+        labels={ACCELERATOR_LABEL: "v5e-4"},
+        spec=VariantAutoscalingSpec(
+            model_id=MODEL,
+            slo_class_ref=ConfigMapKeyRef(name="service-classes-config", key="Premium"),
+            accelerators=[
+                AcceleratorProfile(
+                    acc="v5e-4", acc_count=1, max_batch_size=64, at_tokens=128,
+                    decode_parms=DecodeParms(alpha=23.5, beta=0.3),
+                    prefill_parms=PrefillParms(gamma=5.0, delta=0.02),
+                ),
+                AcceleratorProfile(
+                    acc="v5e-16", acc_count=1, max_batch_size=128, at_tokens=128,
+                    decode_parms=DecodeParms(alpha=4.0, beta=0.05),
+                    prefill_parms=PrefillParms(gamma=2.0, delta=0.005),
+                ),
+            ],
+        ),
+    )
+    cluster.add_variant_autoscaling(va)
+
+    # -- default: reference-exact pin ---------------------------------------
+    rec = Reconciler(
+        kube=cluster,
+        prom=make_prom(arrival_rps=20.0, out_tok=128.0),
+        config=ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar"),
+    )
+    rec.run_cycle()
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    pinned = va.status.desired_optimized_alloc
+    assert pinned.accelerator == "v5e-4"  # pinned despite 50x cheaper rates
+    assert pinned.num_replicas > 10  # ...paying for it in replicas
+
+    # -- KEEP_ACCELERATOR=false: economic migration -------------------------
+    rec = Reconciler(
+        kube=cluster,
+        prom=make_prom(arrival_rps=20.0, out_tok=128.0),
+        config=ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar",
+                                keep_accelerator=False),
+    )
+    rec.run_cycle()
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    moved = va.status.desired_optimized_alloc
+    assert moved.accelerator == "v5e-16", moved
+    assert moved.num_replicas < pinned.num_replicas
+
+    # load gone: back to the cheap shape
+    rec.prom = make_prom(arrival_rps=0.0)
+    rec.run_cycle()
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    assert va.status.desired_optimized_alloc.accelerator == "v5e-4"

@@ -243,3 +243,14 @@ def test_capacity_parsing_tolerates_bad_json():
     optimizer, capacity = rec.read_optimizer_and_capacity()
     assert optimizer.unlimited
     assert capacity.chips == {}
+
+
+def test_migration_with_direct_scale_refused():
+    """KEEP_ACCELERATOR=false + DIRECT_SCALE=true would actuate a shape
+    migration as a bare scale-down on the old hardware; the config must
+    refuse the combination."""
+    with pytest.raises(ValueError, match="KEEP_ACCELERATOR"):
+        ReconcilerConfig(keep_accelerator=False, direct_scale=True)
+    # each alone is fine
+    ReconcilerConfig(keep_accelerator=False)
+    ReconcilerConfig(direct_scale=True)

@@ -2,19 +2,20 @@
 """Build the committed profiles/*.json from raw on-chip measurements.
 
 Inputs (written by tools/profile_tpu.py on the real chip):
-  profiles/raw/llama-3.1-8b_tpu.json       bf16 weights
-  profiles/raw/llama-3.1-8b_tpu_int8.json  int8 weights (w8a16)
+  profiles/raw/<model>_tpu.json       bf16 weights
+  profiles/raw/<model>_tpu_int8.json  int8 weights (w8a16), optional
 
-Outputs:
-  profiles/llama-3.1-8b_v5e-1.json   MEASURED (int8 raw): the only
-      memory-feasible single-chip serving config for an 8B — bf16 weights
-      alone exceed one v5e chip's 16 GB HBM.
-  profiles/llama-3.1-8b_v5e-1-bf16.json  MEASURED (bf16 raw): compute
-      reference point; maxBatchSize is 0 because the config does not fit
-      one chip — kept for fit transparency, not for the optimizer.
-  profiles/llama-3.1-8b_v5e-4.json / _v5e-8.json  DERIVED from the bf16
-      measurement (bf16 weights fit at TP>=4): per-chip traffic divided,
-      analytic ICI all-reduce cost added; marked "derived": true.
+Outputs per model:
+  <model>_v5e-1.json        MEASURED single-chip profile from the best
+      memory-feasible raw (int8 preferred; bf16 when it fits — e.g. a 3B
+      fits one 16 GB chip in bf16, an 8B does not).
+  <model>_v5e-1-bf16.json   MEASURED bf16 reference point when bf16 does
+      NOT fit one chip (maxBatchSize 0; kept for fit transparency).
+  <model>_v5e-4.json / _v5e-8.json            DERIVED TP shapes from the
+      bf16 measurement: per-chip traffic divided, analytic ICI
+      all-reduce cost added; marked "derived": true.
+  <model>_v5e-4-int8.json / _v5e-8-int8.json  DERIVED TP shapes from the
+      int8 measurement (the standard TPU serving config).
 """
 
 import json
@@ -25,43 +26,74 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from inferno_tpu.models.profiles import PROFILES_DIR, build_profile_json
 
+RAW_DIR = PROFILES_DIR / "raw"
+
+
+def build_model(model: str) -> dict[str, dict]:
+    """Profile documents for one model from whatever raws exist."""
+    bf16_path = RAW_DIR / f"{model}_tpu.json"
+    int8_path = RAW_DIR / f"{model}_tpu_int8.json"
+    raw_bf16 = json.loads(bf16_path.read_text()) if bf16_path.exists() else None
+    raw_int8 = json.loads(int8_path.read_text()) if int8_path.exists() else None
+    if raw_bf16 is None and raw_int8 is None:
+        raise SystemExit(f"no raw measurements for {model} under {RAW_DIR}")
+
+    outputs: dict[str, dict] = {}
+
+    def add(suffix, raw, n_chips, wbytes):
+        outputs[f"{model}_{suffix}.json"] = build_profile_json(
+            raw, suffix, n_chips=n_chips, weight_bytes_per_param=wbytes
+        )
+
+    # single-chip: prefer int8 (the denser serving config); keep the bf16
+    # point either as the headline (when it actually fits one chip) or
+    # quarantined under the -bf16 transparency name (maxBatchSize 0 must
+    # never be published as the headline v5e-1 profile)
+    if raw_int8 is not None:
+        add("v5e-1", raw_int8, 1, 1.0)
+        if raw_bf16 is not None:
+            add("v5e-1-bf16", raw_bf16, 1, 2.0)
+    elif raw_bf16 is not None:
+        doc = build_profile_json(raw_bf16, "v5e-1", n_chips=1,
+                                 weight_bytes_per_param=2.0)
+        if doc["maxBatchSize"] > 0:
+            outputs[f"{model}_v5e-1.json"] = doc
+        else:
+            add("v5e-1-bf16", raw_bf16, 1, 2.0)
+
+    # derived TP shapes
+    if raw_bf16 is not None:
+        add("v5e-4", raw_bf16, 4, 2.0)
+        add("v5e-8", raw_bf16, 8, 2.0)
+    if raw_int8 is not None:
+        add("v5e-4-int8", raw_int8, 4, 1.0)
+        add("v5e-8-int8", raw_int8, 8, 1.0)
+    return outputs
+
+
+def discover_models() -> list[str]:
+    names = set()
+    for p in RAW_DIR.glob("*_tpu.json"):
+        names.add(p.name[: -len("_tpu.json")])
+    for p in RAW_DIR.glob("*_tpu_int8.json"):
+        names.add(p.name[: -len("_tpu_int8.json")])
+    return sorted(names)
+
 
 def main() -> None:
-    raw_bf16 = json.loads((PROFILES_DIR / "raw/llama-3.1-8b_tpu.json").read_text())
-    raw_int8 = json.loads((PROFILES_DIR / "raw/llama-3.1-8b_tpu_int8.json").read_text())
-
-    outputs = {
-        # measured single-chip profiles
-        "llama-3.1-8b_v5e-1.json": build_profile_json(
-            raw_int8, "v5e-1", n_chips=1, weight_bytes_per_param=1.0
-        ),
-        "llama-3.1-8b_v5e-1-bf16.json": build_profile_json(
-            raw_bf16, "v5e-1", n_chips=1, weight_bytes_per_param=2.0
-        ),
-        # derived TP shapes: bf16 weights (fit at TP>=4) and int8 (w8a16,
-        # the standard TPU serving config — the autoscaler's usual pick)
-        "llama-3.1-8b_v5e-4.json": build_profile_json(
-            raw_bf16, "v5e-4", n_chips=4, weight_bytes_per_param=2.0
-        ),
-        "llama-3.1-8b_v5e-8.json": build_profile_json(
-            raw_bf16, "v5e-8", n_chips=8, weight_bytes_per_param=2.0
-        ),
-        "llama-3.1-8b_v5e-4-int8.json": build_profile_json(
-            raw_int8, "v5e-4-int8", n_chips=4, weight_bytes_per_param=1.0
-        ),
-        "llama-3.1-8b_v5e-8-int8.json": build_profile_json(
-            raw_int8, "v5e-8-int8", n_chips=8, weight_bytes_per_param=1.0
-        ),
-    }
-    for name, doc in outputs.items():
-        path = PROFILES_DIR / name
-        path.write_text(json.dumps(doc, indent=1) + "\n")
-        print(
-            f"{name}: alpha={doc['decodeParms']['alpha']} beta={doc['decodeParms']['beta']} "
-            f"gamma={doc['prefillParms']['gamma']} delta={doc['prefillParms']['delta']} "
-            f"maxBatch={doc['maxBatchSize']} derived={doc['derived']} "
-            f"r2={doc['fit']['decode_layer_linearity_r2']}"
-        )
+    models = sys.argv[1:] or discover_models()
+    for model in models:
+        for name, doc in build_model(model).items():
+            path = PROFILES_DIR / name
+            path.write_text(json.dumps(doc, indent=1) + "\n")
+            print(
+                f"{name}: alpha={doc['decodeParms']['alpha']} "
+                f"beta={doc['decodeParms']['beta']} "
+                f"gamma={doc['prefillParms']['gamma']} "
+                f"delta={doc['prefillParms']['delta']} "
+                f"maxBatch={doc['maxBatchSize']} derived={doc['derived']} "
+                f"r2={doc['fit']['decode_layer_linearity_r2']}"
+            )
 
 
 if __name__ == "__main__":
