@@ -84,5 +84,12 @@ LABEL_DIRECTION = "direction"
 
 
 def engine_for(name: str) -> EngineMetrics:
-    """Resolve an engine by name; unknown names fall back to vllm-tpu."""
-    return ENGINES.get(name, VLLM_TPU)
+    """Resolve an engine by name. Unknown names raise: a typo'd
+    SERVING_ENGINE silently scraping the wrong vocabulary would surface
+    only as a confusing MetricsMissing condition much later."""
+    try:
+        return ENGINES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown serving engine {name!r}; supported: {sorted(ENGINES)}"
+        ) from None

@@ -85,6 +85,7 @@ class ReconcilerConfig:
                 f"compute_backend must be tpu|tpu-pallas|native|scalar, "
                 f"got {self.compute_backend!r}"
             )
+        engine_for(self.engine)  # raise at config time on unknown engines
         if not self.keep_accelerator and self.direct_scale:
             # direct_scale only patches replica counts on the EXISTING
             # workload; it cannot re-provision pods onto a different slice

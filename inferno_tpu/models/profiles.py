@@ -363,6 +363,72 @@ def build_profile_json(
     }
 
 
+def fit_decode_at_context(raw_ctx: Mapping[str, Any], n_layers_full: int,
+                          n_chips: int = 1) -> tuple[DecodeParms, float]:
+    """Decode alpha/beta from a context-bucket sweep (decode samples only;
+    TTFT's gamma/delta stay with the base profile — prompt length already
+    enters linearly there). Returns (parms, layer-linearity R^2)."""
+    from inferno_tpu.models.linear import _fit_line
+
+    decode, r2 = _extrapolate_layers(
+        list(raw_ctx["decode"]), "step_ms", ("batch",), n_layers_full
+    )
+    d_b = np.array([p["batch"] for p in decode], dtype=np.float64)
+    d_y = np.array([p["step_ms"] for p in decode], dtype=np.float64)
+    alpha, beta, _ = _fit_line(d_b, d_y)
+    fitted = FittedProfile(
+        decode=DecodeParms(alpha=alpha, beta=beta),
+        prefill=PrefillParms(), decode_rmse=0.0, prefill_rmse=0.0,
+    )
+    if n_chips > 1:
+        dims = raw_ctx["meta"]["dims"]
+        fitted = derive_tensor_parallel(
+            fitted, n_chips, n_layers=n_layers_full, hidden=int(dims["hidden"]),
+        )
+    return fitted.decode, r2
+
+
+def attach_context_buckets(
+    doc: dict,
+    context_raws: list[tuple[int, Mapping[str, Any]]],
+    n_chips: int = 1,
+    hbm_per_chip_gb: float = 16.0,
+    weight_bytes_per_param: float = 1.0,
+) -> dict:
+    """Add measured `contextBuckets` to a profile document: per bucket the
+    decode parms are refit from the context sweep, the prefill parms are
+    inherited from the base fit (TTFT is linear in prompt length there),
+    and maxBatchSize is the KV-memory cap at the bucket's context length
+    (SURVEY §5.7: long context as profile dimensions)."""
+    dims_in = dict(doc["measurement_meta"]["dims"])
+    n_layers_full = dims_in.pop("n_layers_full")
+    dims = LlamaDims(**dims_in, n_layers=n_layers_full)
+    buckets = []
+    for max_in_tokens, raw_ctx in sorted(context_raws, key=lambda kv: kv[0]):
+        decode, r2 = fit_decode_at_context(raw_ctx, n_layers_full, n_chips)
+        # budget KV for prompt + decode headroom, matching the base
+        # profile's convention (atTokens 1280 for a 1024-token context):
+        # a request admitted at the bucket bound keeps growing its KV
+        # while decoding
+        max_batch = max_batch_from_memory(
+            dims, hbm_per_chip_gb, max_in_tokens + 256,
+            weight_bytes_per_param=weight_bytes_per_param, n_chips=n_chips,
+        )
+        buckets.append({
+            "maxInTokens": max_in_tokens,
+            "maxBatchSize": max_batch,
+            "perfParms": {
+                "decodeParms": {"alpha": round(decode.alpha, 4),
+                                "beta": round(decode.beta, 5)},
+                "prefillParms": dict(doc["prefillParms"]),
+            },
+            "fit": {"decode_layer_linearity_r2": round(r2, 5),
+                    "measured_context": raw_ctx["meta"].get("decode_context")},
+        })
+    doc["contextBuckets"] = buckets
+    return doc
+
+
 def load_profile(path: str | Path) -> ModelPerfSpec:
     """Load a committed profile JSON as a ModelPerfSpec."""
     return ModelPerfSpec.from_dict(json.loads(Path(path).read_text()))

@@ -173,3 +173,51 @@ def test_committed_profiles_load(path):
     assert doc["fit"]["decode_layer_linearity_r2"] > 0.99
     # committed measured profiles must be marked measured
     assert isinstance(doc["derived"], bool)
+
+
+def test_attach_context_buckets_synthetic():
+    """Measured long-context buckets: per-context decode refit, inherited
+    prefill parms, KV-memory max batch at the bucket's context, and a
+    wire shape the CRD's ContextBucket parser accepts as-is."""
+    import numpy as np
+
+    from inferno_tpu.controller.crd import ContextBucket
+    from inferno_tpu.models.profiles import attach_context_buckets
+
+    dims = {"hidden": 3072, "n_heads": 24, "n_kv_heads": 8, "head_dim": 128,
+            "ffn": 8192, "vocab": 128256, "n_layers_full": 28}
+
+    def raw_at(per_layer_alpha, per_layer_beta, context):
+        return {
+            "meta": {"model": "m", "dims": dims, "decode_context": context},
+            "decode": [
+                {"n_layers": L, "batch": b,
+                 "step_ms": L * (per_layer_alpha + per_layer_beta * b)}
+                for L in (2, 4, 8) for b in (1, 8, 32)
+            ],
+        }
+
+    doc = {
+        "maxBatchSize": 60,
+        "prefillParms": {"gamma": 9.0, "delta": 0.0005},
+        "measurement_meta": {"dims": dims},
+    }
+    out = attach_context_buckets(
+        doc,
+        [(8192, raw_at(0.8, 0.015, 8192)), (4096, raw_at(0.6, 0.012, 4096))],
+        n_chips=1, weight_bytes_per_param=1.0,
+    )
+    buckets = out["contextBuckets"]
+    assert [b["maxInTokens"] for b in buckets] == [4096, 8192]  # sorted
+    b4 = buckets[0]
+    # exact linear synthesis: alpha = 28 * 0.6, beta = 28 * 0.012
+    assert b4["perfParms"]["decodeParms"]["alpha"] == pytest.approx(16.8, rel=1e-3)
+    assert b4["perfParms"]["decodeParms"]["beta"] == pytest.approx(0.336, rel=1e-3)
+    assert b4["perfParms"]["prefillParms"] == {"gamma": 9.0, "delta": 0.0005}
+    assert b4["fit"]["decode_layer_linearity_r2"] == pytest.approx(1.0)
+    # longer context -> smaller memory-feasible batch
+    assert buckets[1]["maxBatchSize"] < b4["maxBatchSize"] < 60
+    # the bucket dict IS the CR wire shape
+    cb = ContextBucket.from_dict(b4)
+    assert cb.max_in_tokens == 4096
+    assert cb.decode_parms.alpha == pytest.approx(16.8, rel=1e-3)

@@ -254,3 +254,10 @@ def test_migration_with_direct_scale_refused():
     # each alone is fine
     ReconcilerConfig(keep_accelerator=False)
     ReconcilerConfig(direct_scale=True)
+
+
+def test_unknown_engine_refused_at_config_time():
+    """A typo'd SERVING_ENGINE must fail fast, not silently scrape the
+    wrong metric vocabulary for the life of the process."""
+    with pytest.raises(ValueError, match="sglang"):
+        ReconcilerConfig(engine="sglang")

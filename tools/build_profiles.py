@@ -24,9 +24,22 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from inferno_tpu.models.profiles import PROFILES_DIR, build_profile_json
+from inferno_tpu.models.profiles import (
+    PROFILES_DIR,
+    attach_context_buckets,
+    build_profile_json,
+)
 
 RAW_DIR = PROFILES_DIR / "raw"
+
+
+def context_raws(model: str, dtype_suffix: str) -> list[tuple[int, dict]]:
+    """[(max_in_tokens, raw)] for `<model>_tpu<dtype>_ctx<N>.json` sweeps."""
+    out = []
+    for p in sorted(RAW_DIR.glob(f"{model}_tpu{dtype_suffix}_ctx*.json")):
+        tokens = int(p.stem.rsplit("_ctx", 1)[1])
+        out.append((tokens, json.loads(p.read_text())))
+    return out
 
 
 def build_model(model: str) -> dict[str, dict]:
@@ -38,12 +51,20 @@ def build_model(model: str) -> dict[str, dict]:
     if raw_bf16 is None and raw_int8 is None:
         raise SystemExit(f"no raw measurements for {model} under {RAW_DIR}")
 
+    ctx_bf16 = context_raws(model, "")
+    ctx_int8 = context_raws(model, "_int8")
     outputs: dict[str, dict] = {}
 
     def add(suffix, raw, n_chips, wbytes):
-        outputs[f"{model}_{suffix}.json"] = build_profile_json(
+        doc = build_profile_json(
             raw, suffix, n_chips=n_chips, weight_bytes_per_param=wbytes
         )
+        # attach measured long-context buckets from matching-dtype sweeps
+        ctx = ctx_int8 if wbytes == 1.0 else ctx_bf16
+        if ctx and doc["maxBatchSize"] > 0:
+            attach_context_buckets(doc, ctx, n_chips=n_chips,
+                                   weight_bytes_per_param=wbytes)
+        outputs[f"{model}_{suffix}.json"] = doc
 
     # single-chip: prefer int8 (the denser serving config); keep the bf16
     # point either as the headline (when it actually fits one chip) or
@@ -54,10 +75,10 @@ def build_model(model: str) -> dict[str, dict]:
         if raw_bf16 is not None:
             add("v5e-1-bf16", raw_bf16, 1, 2.0)
     elif raw_bf16 is not None:
-        doc = build_profile_json(raw_bf16, "v5e-1", n_chips=1,
-                                 weight_bytes_per_param=2.0)
-        if doc["maxBatchSize"] > 0:
-            outputs[f"{model}_v5e-1.json"] = doc
+        probe = build_profile_json(raw_bf16, "v5e-1", n_chips=1,
+                                   weight_bytes_per_param=2.0)
+        if probe["maxBatchSize"] > 0:
+            add("v5e-1", raw_bf16, 1, 2.0)  # via add(): buckets attach
         else:
             add("v5e-1-bf16", raw_bf16, 1, 2.0)
 
@@ -83,7 +104,15 @@ def discover_models() -> list[str]:
 def main() -> None:
     models = sys.argv[1:] or discover_models()
     for model in models:
-        for name, doc in build_model(model).items():
+        try:
+            built = build_model(model)
+        except ValueError as e:
+            # an in-progress sweep (single layer depth so far) must not
+            # abort regeneration of every other model's profiles
+            print(f"skipping {model}: raw sweep not fittable yet ({e})",
+                  file=sys.stderr)
+            continue
+        for name, doc in built.items():
             path = PROFILES_DIR / name
             path.write_text(json.dumps(doc, indent=1) + "\n")
             print(
