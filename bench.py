@@ -130,15 +130,15 @@ TPU_SHAPES = {  # committed profile name -> chips (cost = chips x chip-hr)
 }
 
 
-def north_star() -> dict:
-    # size EVERY committed slice-shape profile and let the cheapest
-    # feasible one be the headline — shape selection is the autoscaler's
-    # own decision procedure, not cherry-picking (solver.SolveUnlimited
-    # semantics: min cost per server across candidate accelerators)
+def size_model_shapes(model: str) -> dict:
+    """{acc: usd_per_mtok result (+ 'profile' meta)} for every committed,
+    memory- and SLO-feasible slice shape of `model` — the autoscaler's own
+    decision surface (SolveUnlimited semantics: min cost per server across
+    candidate accelerators), shared by the headline and secondary tables."""
     per_shape = {}
     for acc, chips in TPU_SHAPES.items():
         try:
-            prof = load_named_profile("llama-3.1-8b", acc)
+            prof = load_named_profile(model, acc)
         except FileNotFoundError:
             continue
         if prof.max_batch_size <= 0:
@@ -155,6 +155,11 @@ def north_star() -> dict:
             "gamma": prof.prefill_parms.gamma, "delta": prof.prefill_parms.delta,
             "max_batch": prof.max_batch_size, "chips": chips,
         }
+    return per_shape
+
+
+def north_star() -> dict:
+    per_shape = size_model_shapes("llama-3.1-8b")
     if not per_shape:
         raise SystemExit(
             "no committed TPU profile is SLO-feasible; run tools/profile_tpu.py "
@@ -162,6 +167,19 @@ def north_star() -> dict:
         )
     best_acc = min(per_shape, key=lambda a: per_shape[a]["usd_per_mtok"])
     tpu = per_shape[best_acc]
+
+    # secondary model families in the committed profile store, sized by the
+    # same machinery at the same SLO/workload (no A100 baseline exists for
+    # them in the reference; reported for breadth, not the headline)
+    secondary = {}
+    for model in ("llama-3.2-3b",):
+        shapes = size_model_shapes(model)
+        by_shape = {a: round(v["usd_per_mtok"], 4) for a, v in shapes.items()}
+        if by_shape:
+            secondary[model] = {
+                "per_shape_usd_per_mtok": by_shape,
+                "best": min(by_shape, key=by_shape.get),
+            }
     a100 = usd_per_mtok(A100["decode"], A100["prefill"], A100["max_batch"], A100_HR)
     # $/Mtok is linear in the price constant: the fixture-cost sensitivity
     # is a rescale, not another sizing solve
@@ -175,6 +193,7 @@ def north_star() -> dict:
         "a100": a100,
         "vs_baseline": a100["usd_per_mtok"] / tpu["usd_per_mtok"],
         "profile": tpu.pop("profile"),
+        "secondary_models": secondary,
         "sensitivity": {
             "a100_at_fixture_cost_usd_per_mtok": a100_fixture_usd,
             "workload": {"in": REQ.avg_in_tokens, "out": REQ.avg_out_tokens,
@@ -397,6 +416,7 @@ def main() -> None:
                         "tpu_tok_s_per_replica": round(ns["tpu"]["tok_s_per_replica"], 1),
                         "a100_tok_s_per_replica": round(ns["a100"]["tok_s_per_replica"], 1),
                         "profile": ns["profile"],
+                        "secondary_models": ns["secondary_models"],
                         "sensitivity": ns["sensitivity"],
                     },
                     "fleet_cycle": cycles,

@@ -41,6 +41,12 @@ from inferno_tpu.models.llama_block import LlamaDims
 PROFILES_DIR = Path(__file__).resolve().parent.parent.parent / "profiles"
 
 
+class UnfittableRawError(ValueError):
+    """A raw sweep that cannot be fitted yet (e.g. a single layer depth
+    from an in-progress run) — distinct from schema/parse errors so tools
+    can skip it without masking real corruption."""
+
+
 def _extrapolate_layers(
     samples: list[dict], key: str, group_keys: tuple[str, ...], n_layers_full: int
 ) -> tuple[list[dict], float]:
@@ -74,7 +80,7 @@ def _extrapolate_layers(
         rec[key] = full
         out.append(rec)
     if not out:
-        raise ValueError(
+        raise UnfittableRawError(
             f"need >=2 layer depths for at least one point; "
             f"all {skipped} groups single-depth"
         )
@@ -414,6 +420,12 @@ def attach_context_buckets(
             dims, hbm_per_chip_gb, max_in_tokens + 256,
             weight_bytes_per_param=weight_bytes_per_param, n_chips=n_chips,
         )
+        if max_batch <= 0:
+            # memory-infeasible at this context: the CRD wire format
+            # reads maxBatchSize 0 as "inherit the base batch", which
+            # would publish a physically impossible configuration — drop
+            # the bucket; loads beyond the last bucket use base parms
+            continue
         buckets.append({
             "maxInTokens": max_in_tokens,
             "maxBatchSize": max_batch,

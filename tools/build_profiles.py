@@ -26,6 +26,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from inferno_tpu.models.profiles import (
     PROFILES_DIR,
+    UnfittableRawError,
     attach_context_buckets,
     build_profile_json,
 )
@@ -55,16 +56,18 @@ def build_model(model: str) -> dict[str, dict]:
     ctx_int8 = context_raws(model, "_int8")
     outputs: dict[str, dict] = {}
 
-    def add(suffix, raw, n_chips, wbytes):
-        doc = build_profile_json(
-            raw, suffix, n_chips=n_chips, weight_bytes_per_param=wbytes
-        )
+    def register(suffix, doc, n_chips, wbytes):
         # attach measured long-context buckets from matching-dtype sweeps
         ctx = ctx_int8 if wbytes == 1.0 else ctx_bf16
         if ctx and doc["maxBatchSize"] > 0:
             attach_context_buckets(doc, ctx, n_chips=n_chips,
                                    weight_bytes_per_param=wbytes)
         outputs[f"{model}_{suffix}.json"] = doc
+
+    def add(suffix, raw, n_chips, wbytes):
+        register(suffix, build_profile_json(
+            raw, suffix, n_chips=n_chips, weight_bytes_per_param=wbytes
+        ), n_chips, wbytes)
 
     # single-chip: prefer int8 (the denser serving config); keep the bf16
     # point either as the headline (when it actually fits one chip) or
@@ -75,12 +78,13 @@ def build_model(model: str) -> dict[str, dict]:
         if raw_bf16 is not None:
             add("v5e-1-bf16", raw_bf16, 1, 2.0)
     elif raw_bf16 is not None:
-        probe = build_profile_json(raw_bf16, "v5e-1", n_chips=1,
-                                   weight_bytes_per_param=2.0)
-        if probe["maxBatchSize"] > 0:
-            add("v5e-1", raw_bf16, 1, 2.0)  # via add(): buckets attach
+        doc = build_profile_json(raw_bf16, "v5e-1", n_chips=1,
+                                 weight_bytes_per_param=2.0)
+        if doc["maxBatchSize"] > 0:
+            register("v5e-1", doc, 1, 2.0)
         else:
-            add("v5e-1-bf16", raw_bf16, 1, 2.0)
+            doc["acc"] = "v5e-1-bf16"
+            register("v5e-1-bf16", doc, 1, 2.0)
 
     # derived TP shapes
     if raw_bf16 is not None:
@@ -106,9 +110,10 @@ def main() -> None:
     for model in models:
         try:
             built = build_model(model)
-        except ValueError as e:
+        except UnfittableRawError as e:
             # an in-progress sweep (single layer depth so far) must not
-            # abort regeneration of every other model's profiles
+            # abort regeneration of every other model's profiles; any
+            # other error (schema mismatch, corrupt file) propagates
             print(f"skipping {model}: raw sweep not fittable yet ({e})",
                   file=sys.stderr)
             continue
