@@ -12,6 +12,7 @@ canned or computed samples for tests (the analogue of MockPromAPI,
 from __future__ import annotations
 
 import dataclasses
+import http.client
 import json
 import ssl
 import time
@@ -94,7 +95,14 @@ class HttpPromClient:
         try:
             with urllib.request.urlopen(req, context=self.ctx, timeout=30) as resp:
                 payload = json.loads(resp.read())
-        except (urllib.error.URLError, TimeoutError, json.JSONDecodeError) as e:
+        except (
+            # OSError covers URLError (handshake-time TLS failures,
+            # refused connections), ssl.SSLError raised mid-read (TLS 1.3
+            # alerts surface on first read, not at connect), and timeouts
+            OSError,
+            http.client.HTTPException,  # truncated chunked responses
+            json.JSONDecodeError,
+        ) as e:
             raise PromError(f"query failed: {e}") from e
         if payload.get("status") != "success":
             raise PromError(f"query error: {payload.get('error', 'unknown')}")

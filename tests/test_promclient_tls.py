@@ -1,0 +1,171 @@
+"""Client-side Prometheus transport security (controller/promclient.py):
+HTTPS enforcement, CA verification, insecure opt-out, bearer rotation —
+the analogue of the reference's transport tests
+(internal/utils/{tls,prometheus_transport}.go, e2e TLS scenarios at
+test/e2e/e2e_test.go:565-630)."""
+
+import json
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from inferno_tpu.controller.promclient import HttpPromClient, PromConfig, PromError
+
+from test_metrics_tls import make_cert
+
+
+class TlsProm:
+    """Minimal HTTPS Prometheus answering /api/v1/query, recording the
+    Authorization header of every request."""
+
+    def __init__(self, cert, key):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                outer.auth_headers.append(self.headers.get("Authorization"))
+                body = json.dumps({
+                    "status": "success",
+                    "data": {"resultType": "vector", "result": [
+                        {"metric": {"m": "x"}, "value": [0, "1.5"]}
+                    ]},
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.auth_headers: list = []
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
+        self.port = self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture()
+def tls_prom(tmp_path):
+    cert, key = make_cert(tmp_path, "prom")
+    srv = TlsProm(cert, key)
+    yield srv, cert, tmp_path
+    srv.stop()
+
+
+def test_http_scheme_rejected_by_default():
+    with pytest.raises(PromError, match="https"):
+        HttpPromClient(PromConfig(base_url="http://prom:9090"))
+
+
+def test_http_scheme_allowed_only_with_opt_in():
+    HttpPromClient(PromConfig(base_url="http://prom:9090", allow_http=True))
+
+
+def test_min_tls_version_enforced():
+    client = HttpPromClient(PromConfig(base_url="https://prom:9090"))
+    assert client.ctx.minimum_version == ssl.TLSVersion.TLSv1_2
+
+
+def test_query_with_trusted_ca(tls_prom):
+    srv, cert, _ = tls_prom
+    client = HttpPromClient(PromConfig(
+        base_url=f"https://127.0.0.1:{srv.port}", ca_file=cert,
+    ))
+    samples = client.query('up{job="x"}')
+    assert samples and samples[0].value == 1.5
+
+
+def test_untrusted_cert_fails_as_prom_error(tls_prom):
+    srv, _, _ = tls_prom
+    client = HttpPromClient(PromConfig(base_url=f"https://127.0.0.1:{srv.port}"))
+    with pytest.raises(PromError):
+        client.query("up")
+    assert not client.healthy()
+
+
+def test_insecure_skip_verify_opt_out(tls_prom):
+    srv, _, _ = tls_prom
+    client = HttpPromClient(PromConfig(
+        base_url=f"https://127.0.0.1:{srv.port}", insecure_skip_verify=True,
+    ))
+    assert client.query("up")
+
+
+def test_bearer_token_file_rotation(tls_prom):
+    """Projected service-account tokens rotate without restart: the file
+    is re-read per request (reference prometheus_transport.go:33-80)."""
+    srv, cert, tmp_path = tls_prom
+    token_file = tmp_path / "token"
+    token_file.write_text("token-one")
+    client = HttpPromClient(PromConfig(
+        base_url=f"https://127.0.0.1:{srv.port}", ca_file=cert,
+        bearer_token_file=str(token_file),
+    ))
+    client.query("up")
+    token_file.write_text("token-two")
+    client.query("up")
+    assert srv.auth_headers[-2:] == ["Bearer token-one", "Bearer token-two"]
+
+
+def test_static_bearer_token(tls_prom):
+    srv, cert, _ = tls_prom
+    client = HttpPromClient(PromConfig(
+        base_url=f"https://127.0.0.1:{srv.port}", ca_file=cert,
+        bearer_token="static-tok",
+    ))
+    client.query("up")
+    assert srv.auth_headers[-1] == "Bearer static-tok"
+
+
+def test_mutual_tls_client_pair(tmp_path):
+    """mTLS: a server requiring client certificates accepts the client
+    pair from PromConfig and rejects clients without one
+    (reference tls.go:31-55)."""
+    server_cert, server_key = make_cert(tmp_path, "srv")
+    client_cert, client_key = make_cert(tmp_path, "cli")
+
+    outer_headers = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            outer_headers.append(1)
+            body = json.dumps({"status": "success",
+                               "data": {"resultType": "vector", "result": []}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(server_cert, server_key)
+    ctx.load_verify_locations(client_cert)
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
+    port = httpd.server_port
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        with_pair = HttpPromClient(PromConfig(
+            base_url=f"https://127.0.0.1:{port}", ca_file=server_cert,
+            client_cert_file=client_cert, client_key_file=client_key,
+        ))
+        assert with_pair.query("up") == []
+        without = HttpPromClient(PromConfig(
+            base_url=f"https://127.0.0.1:{port}", ca_file=server_cert,
+        ))
+        with pytest.raises(PromError):
+            without.query("up")
+    finally:
+        httpd.shutdown()
