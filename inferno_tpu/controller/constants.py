@@ -9,3 +9,12 @@ ConfigMap names mirror the reference's configuration surface
 CM_CONFIG = "inferno-autoscaler-config"
 CM_ACCELERATOR_COSTS = "accelerator-unit-costs"
 CM_SERVICE_CLASSES = "service-classes-config"
+
+
+def parse_bool(value: str, default: bool = False) -> bool:
+    """Truthy-string parsing shared by env knobs (main.env_bool) and
+    ConfigMap knobs (reconciler) so accepted spellings cannot diverge."""
+    v = (value or "").strip().lower()
+    if not v:
+        return default
+    return v in ("1", "true", "yes", "on")

@@ -45,10 +45,9 @@ import time
 
 
 def env_bool(name: str, default: bool = False) -> bool:
-    v = os.environ.get(name, "")
-    if not v:
-        return default
-    return v.strip().lower() in ("1", "true", "yes", "on")
+    from inferno_tpu.controller.constants import parse_bool
+
+    return parse_bool(os.environ.get(name, ""), default)
 
 
 def prom_config_from_env():

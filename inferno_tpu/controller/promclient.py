@@ -110,15 +110,21 @@ class HttpPromClient:
         if data.get("resultType") != "vector":
             return []
         out = []
-        for item in data.get("result", []):
-            ts, val = item.get("value", [time.time(), "0"])
-            try:
-                fval = float(val)
-            except ValueError:
-                fval = 0.0
-            out.append(
-                Sample(labels=dict(item.get("metric", {})), value=fval, timestamp=float(ts))
-            )
+        try:
+            for item in data.get("result", []):
+                ts, val = item.get("value", [time.time(), "0"])
+                try:
+                    fval = float(val)
+                except (ValueError, TypeError):
+                    fval = 0.0
+                out.append(
+                    Sample(labels=dict(item.get("metric", {})),
+                           value=fval, timestamp=float(ts or 0.0))
+                )
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            # a proxy returning structurally-broken 200s must land on the
+            # same handled path as transport failures
+            raise PromError(f"malformed query response: {e}") from e
         return out
 
     def healthy(self) -> bool:

@@ -66,6 +66,7 @@ from inferno_tpu.controller.constants import (  # noqa: E402,F401 (re-export)
     CM_ACCELERATOR_COSTS,
     CM_CONFIG,
     CM_SERVICE_CLASSES,
+    parse_bool,
 )
 
 
@@ -239,6 +240,7 @@ class Reconciler:
         optimizer = OptimizerSpec(
             unlimited=(data.get("OPTIMIZER_MODE", "unlimited").lower() != "limited"),
             saturation_policy=data.get("SATURATION_POLICY", "None"),
+            delayed_best_effort=parse_bool(data.get("DELAYED_BEST_EFFORT", "")),
         )
         capacity = CapacitySpec()
         raw = data.get("TPU_CAPACITY", "")

@@ -1,0 +1,71 @@
+"""Optimizer wrapper contract (solver/optimizer.py): calculation
+auto-detection, timing fields, diffs, and pool usage — the reference's
+optimizer+manager seam without the singleton
+(pkg/solver/optimizer.go:24-48, pkg/manager/manager.go:13-27)."""
+
+import pytest
+
+from fixtures import make_server, make_system_spec
+from inferno_tpu.core import System
+from inferno_tpu.solver import Optimizer, optimize
+
+SRV = "default/llama-premium"
+
+
+def test_auto_calculates_fresh_system():
+    sys = System(make_system_spec())
+    result = Optimizer().optimize(sys)
+    assert sys.candidates_calculated
+    assert SRV in result.solution
+    assert result.solution[SRV].num_replicas >= 1
+    assert result.analysis_time_msec > 0
+    assert result.solution_time_msec >= 0
+
+
+def test_auto_skips_presized_system():
+    """A system prepared by calculate_fleet must not be silently re-sized
+    by the scalar loop (candidates_calculated gate)."""
+    sys = System(make_system_spec())
+    sys.calculate_all()
+    sentinel = dict(sys.servers[SRV].all_allocations)
+    result = Optimizer().optimize(sys)
+    # same objects: no re-sizing happened
+    assert sys.servers[SRV].all_allocations == sentinel
+    assert result.analysis_time_msec < 50.0  # no second sizing pass
+
+
+def test_calculate_false_with_empty_candidates_yields_no_solution():
+    sys = System(make_system_spec())
+    result = Optimizer().optimize(sys, calculate=False)
+    assert result.solution == {}
+
+
+def test_diffs_reflect_transition():
+    from inferno_tpu.config.types import AllocationData
+
+    current = AllocationData(accelerator="v5e-4", num_replicas=1)
+    spec = make_system_spec([make_server(arrival_rate=3000.0, current=current)])
+    sys = System(spec)
+    result = optimize(sys)
+    diff = result.diffs[SRV]
+    assert diff.old_num_replicas == 1
+    assert diff.new_num_replicas == result.solution[SRV].num_replicas
+    assert diff.new_num_replicas > 1  # load forces scale-out
+    assert diff.cost_diff > 0
+
+
+def test_pool_usage_matches_solution():
+    sys = System(make_system_spec([make_server(name="a"), make_server(name="b")]))
+    result = optimize(sys)
+    total_chips = sum(u.chips for u in result.pool_usage.values())
+    expect = 0
+    for name, data in result.solution.items():
+        acc = sys.accelerators[data.accelerator]
+        expect += data.num_replicas * acc.chips
+    assert total_chips == expect > 0
+
+
+def test_result_solution_carries_load():
+    sys = System(make_system_spec())
+    result = optimize(sys)
+    assert result.solution[SRV].load.arrival_rate == 120.0

@@ -261,3 +261,28 @@ def test_unknown_engine_refused_at_config_time():
     wrong metric vocabulary for the life of the process."""
     with pytest.raises(ValueError, match="sglang"):
         ReconcilerConfig(engine="sglang")
+
+
+def test_delayed_best_effort_cm_knob():
+    """Limited-mode best-effort deferral is configurable via the config
+    ConfigMap like the other optimizer knobs (reference
+    OptimizerSpec.DelayedBestEffort, pkg/config/types.go:151-155)."""
+    cluster = make_cluster()
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {
+        "OPTIMIZER_MODE": "limited",
+        "SATURATION_POLICY": "PriorityRoundRobin",
+        "DELAYED_BEST_EFFORT": "true",
+        "TPU_CAPACITY": json.dumps({"v5e": 64}),
+    })
+    rec = reconciler(cluster, make_prom())
+    optimizer, capacity = rec.read_optimizer_and_capacity()
+    assert not optimizer.unlimited
+    assert optimizer.saturation_policy == "PriorityRoundRobin"
+    assert optimizer.delayed_best_effort is True
+    assert capacity.chips == {"v5e": 64}
+
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {
+        "OPTIMIZER_MODE": "limited",
+    })
+    optimizer, _ = rec.read_optimizer_and_capacity()
+    assert optimizer.delayed_best_effort is False
