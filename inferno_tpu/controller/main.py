@@ -43,10 +43,10 @@ import signal
 import sys
 import time
 
+from inferno_tpu.controller.constants import parse_bool
+
 
 def env_bool(name: str, default: bool = False) -> bool:
-    from inferno_tpu.controller.constants import parse_bool
-
     return parse_bool(os.environ.get(name, ""), default)
 
 
