@@ -60,7 +60,9 @@ class _Series:
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} {self.kind}"
-        for labels, value in self.values.values():
+        # snapshot: mutators (set/inc/remove, incl. per-cycle pruning) run
+        # on the reconcile thread while /metrics scrapes render here
+        for labels, value in list(self.values.values()):
             yield f"{self.name}{_fmt_labels(labels)} {value}"
 
 
@@ -132,10 +134,7 @@ class MetricsEmitter:
         # stale values forever.
         prev = self._last_accelerator.get((namespace, variant))
         if prev is not None and prev != accelerator:
-            old = {**labels, LABEL_ACCELERATOR: prev}
-            for series in (self.desired_replicas, self.current_replicas,
-                           self.desired_ratio):
-                series.remove(old)
+            self._drop_gauges(namespace, variant, prev)
         self._last_accelerator[(namespace, variant)] = accelerator
         self.desired_replicas.set(labels, float(desired))
         self.current_replicas.set(labels, float(current))
@@ -146,6 +145,31 @@ class MetricsEmitter:
         if desired != current:
             direction = "up" if desired > current else "down"
             self.scaling_total.inc({**labels, LABEL_DIRECTION: direction})
+
+    def _drop_gauges(self, namespace: str, variant: str, accelerator: str) -> None:
+        """Remove the variant's gauge series for one accelerator keying —
+        the single removal point for shape migrations and deletions (the
+        scaling counter keeps its history; counters are cumulative)."""
+        old = {
+            LABEL_OUT_NAMESPACE: namespace,
+            LABEL_VARIANT: variant,
+            LABEL_ACCELERATOR: accelerator,
+        }
+        for series in (self.desired_replicas, self.current_replicas,
+                       self.desired_ratio):
+            series.remove(old)
+
+    def prune_variants(self, active: set[tuple[str, str]]) -> None:
+        """Drop gauge series of variants no longer managed — a deleted VA
+        must not leave frozen desired/current/ratio values that HPA or
+        the adapter keep reading (the reference never removes them,
+        internal/metrics/metrics.go; a controller-restart-only cleanup).
+        The scaling counter keeps its history (counters are cumulative)."""
+        for key in list(self._last_accelerator):
+            if key in active:
+                continue
+            ns, variant = key
+            self._drop_gauges(ns, variant, self._last_accelerator.pop(key))
 
 
 class TLSConfig:

@@ -520,6 +520,10 @@ class Reconciler:
             report.optimization_ok = False
             return report
         report.variants_seen = len(vas)
+        # deleted variants: drop their telemetry state and gauge series
+        # (leaving frozen gauges would keep external actuators acting on a
+        # variant that no longer exists)
+        self.emitter.prune_variants({(va.namespace, va.name) for va in vas})
         if self.corrector is not None:
             self.corrector.prune({va.full_name for va in vas})
         if not vas:

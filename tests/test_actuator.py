@@ -188,3 +188,24 @@ def test_shape_migration_drops_old_accelerator_series():
     for line in emitter.registry.render().splitlines():
         if 'accelerator="v5e-4"' in line:
             assert line.startswith("inferno_replica_scaling_total"), line
+
+
+def test_deleted_variant_gauges_pruned():
+    """prune_variants drops the gauge series of variants no longer
+    managed; active variants and counter history are untouched."""
+    emitter = MetricsEmitter()
+    cluster = InMemoryCluster()
+    cluster.add_deployment(NS, "llama", replicas=1)
+    cluster.add_deployment(NS, "other", replicas=1)
+    act = Actuator(kube=cluster, emitter=emitter)
+    act.emit_metrics(make_va(desired=2))
+    va2 = make_va(desired=1)
+    va2.name = "other"
+    act.emit_metrics(va2)
+
+    emitter.prune_variants({(NS, "other")})  # "llama" was deleted
+    assert emitter.desired_replicas.get(labels()) is None
+    other = {**labels(), LABEL_VARIANT: "other"}
+    assert emitter.desired_replicas.get(other) == 1.0
+    # counter history survives (cumulative by contract)
+    assert emitter.scaling_total.get({**labels(), LABEL_DIRECTION: "up"}) == 1.0

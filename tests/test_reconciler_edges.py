@@ -286,3 +286,23 @@ def test_delayed_best_effort_cm_knob():
     })
     optimizer, _ = rec.read_optimizer_and_capacity()
     assert optimizer.delayed_best_effort is False
+
+
+def test_deleted_variant_gauges_removed_next_cycle():
+    """The cycle prunes gauges of VAs that vanished: no frozen desired/
+    current values for external actuators to keep consuming."""
+    from inferno_tpu.controller.engines import (
+        LABEL_ACCELERATOR, LABEL_OUT_NAMESPACE, LABEL_VARIANT,
+    )
+
+    cluster = make_cluster()
+    rec = reconciler(cluster, make_prom(), direct_scale=True)
+    rec.run_cycle()
+    lbl = {LABEL_OUT_NAMESPACE: NS, LABEL_VARIANT: "llama-premium",
+           LABEL_ACCELERATOR: "v5e-4"}
+    assert rec.emitter.desired_replicas.get(lbl) is not None
+
+    cluster.delete_variant_autoscaling(NS, "llama-premium")
+    rec.run_cycle()
+    assert rec.emitter.desired_replicas.get(lbl) is None
+    assert rec.emitter.current_replicas.get(lbl) is None
