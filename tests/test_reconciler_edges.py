@@ -306,3 +306,35 @@ def test_deleted_variant_gauges_removed_next_cycle():
     rec.run_cycle()
     assert rec.emitter.desired_replicas.get(lbl) is None
     assert rec.emitter.current_replicas.get(lbl) is None
+
+
+def test_shared_model_id_variants_keep_distinct_profiles():
+    """Two variants serving the SAME modelID with different CR profiles
+    must not overwrite each other in the per-cycle registry (the perf
+    registry is keyed (model, acc) last-wins; the reconciler namespaces
+    the key per variant)."""
+    import copy
+
+    cluster = make_cluster()
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    va2 = copy.deepcopy(va)
+    va2.name = "llama-premium-b"
+    # same modelID, much slower decode profile: B needs far more replicas
+    va2.spec.accelerators = [va2.spec.accelerators[0]]
+    va2.spec.accelerators[0].decode_parms = type(
+        va2.spec.accelerators[0].decode_parms
+    )(alpha=23.0, beta=0.3)
+    cluster.add_variant_autoscaling(va2)
+    cluster.add_deployment(NS, "llama-premium-b", replicas=1)
+
+    rec = reconciler(cluster, make_prom(arrival_rps=10.0))
+    report = rec.run_cycle()
+    assert report.optimization_ok, report.errors
+    fast = cluster.get_variant_autoscaling(NS, "llama-premium")
+    slow = cluster.get_variant_autoscaling(NS, "llama-premium-b")
+    n_fast = fast.status.desired_optimized_alloc.num_replicas
+    n_slow = slow.status.desired_optimized_alloc.num_replicas
+    assert n_fast >= 1 and n_slow >= 1
+    # the slow profile needs strictly more replicas for the same load; if
+    # the registry had last-wins clobbered the profiles they'd be equal
+    assert n_slow > n_fast, (n_fast, n_slow)

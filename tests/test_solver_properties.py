@@ -152,3 +152,47 @@ def test_higher_priority_served_first_under_scarcity():
     optimize(system, spec.optimizer)
     prem_alloc = system.servers["prem"].allocation
     assert prem_alloc is not None and prem_alloc.accelerator, "premium starved"
+
+
+@pytest.mark.slow
+def test_large_fleet_limited_mode_invariants():
+    """200 variants x 4 shapes under a tight chip budget: capacity holds,
+    higher priorities are never starved in favor of lower ones, and the
+    whole solve (scalar sizing + greedy) stays well under a reconcile
+    interval."""
+    import time as _time
+
+    rng = np.random.default_rng(42)
+    spec = random_spec(rng, n_servers=200, unlimited=False,
+                       capacity_chips=2000, policy="PriorityExhaustive")
+    system = System(spec)
+    t0 = _time.perf_counter()
+    optimize(system, spec.optimizer)
+    wall = _time.perf_counter() - t0
+    assert wall < 30.0, f"solve took {wall:.1f}s"
+
+    used = chips_used(system)
+    for pool, n in used.items():
+        assert n <= 2000, (pool, n)
+
+    # no priority inversion in SATURATION: if any Premium server ended up
+    # unallocated, no Free server may hold chips it could have used
+    # (PriorityExhaustive semantics: higher priorities drained first)
+    premium_unmet = [
+        s for s in system.servers.values()
+        if s.service_class_name == "Premium" and s.allocation is None
+    ]
+    if premium_unmet:
+        free_allocated = [
+            s for s in system.servers.values()
+            if s.service_class_name == "Free" and s.allocation is not None
+            and s.allocation.accelerator
+        ]
+        assert not free_allocated, (
+            f"{len(premium_unmet)} Premium unallocated while "
+            f"{len(free_allocated)} Free hold capacity"
+        )
+    # every allocated server meets its floor
+    for s in system.servers.values():
+        if s.allocation is not None and s.allocation.accelerator:
+            assert s.allocation.num_replicas >= 1
