@@ -72,26 +72,32 @@ def make_va_doc(name="llama-premium", model="meta/llama-3.1-8b"):
     }
 
 
-def seed_cluster(server, interval="30s"):
-    """Seed the three controller ConfigMaps, one VA, and its Deployment —
-    the minimal reconcilable cluster, shared by the cycle/process tests."""
+def seed_config(server, interval="30s", accelerator="v5e-4",
+                model="meta/llama-3.1-8b"):
+    """Seed the three controller ConfigMaps (shared by every cycle test)."""
     for path, body in [
         (f"/api/v1/namespaces/{CFG_NS}/configmaps",
          {"metadata": {"name": "accelerator-unit-costs", "namespace": CFG_NS},
-          "data": {"v5e-4": json.dumps({"cost": 10.0})}}),
+          "data": {accelerator: json.dumps({"cost": 10.0})}}),
         (f"/api/v1/namespaces/{CFG_NS}/configmaps",
          {"metadata": {"name": "service-classes-config", "namespace": CFG_NS},
           "data": {"premium.yaml": (
               "name: Premium\npriority: 1\ndata:\n"
-              "  - model: meta/llama-3.1-8b\n    slo-ttft: 500\n    slo-tpot: 24\n"
+              f"  - model: {model}\n    slo-ttft: 500\n    slo-tpot: 24\n"
           )}}),
         (f"/api/v1/namespaces/{CFG_NS}/configmaps",
          {"metadata": {"name": "inferno-autoscaler-config", "namespace": CFG_NS},
           "data": {"GLOBAL_OPT_INTERVAL": interval}}),
-        (f"/apis/llmd.ai/v1alpha1/namespaces/{NS}/variantautoscalings",
-         make_va_doc()),
     ]:
         post(server, path, body)
+
+
+def seed_cluster(server, interval="30s"):
+    """The minimal reconcilable cluster: ConfigMaps, one VA, its
+    Deployment — shared by the cycle/process tests."""
+    seed_config(server, interval=interval)
+    post(server, f"/apis/llmd.ai/v1alpha1/namespaces/{NS}/variantautoscalings",
+         make_va_doc())
     add_deployment(server, NS, "llama-premium", replicas=1)
 
 
@@ -468,3 +474,48 @@ def test_run_cycle_scales_real_deployment_over_http(server, client):
     # status survived schema validation against the committed CRD
     cond = va.status.condition("OptimizationReady")
     assert cond is not None and cond.status == "True"
+
+
+def test_run_cycle_scales_lws_groups_over_http(server, client):
+    """Multi-host over the wire: a v5e-16 variant backed by a
+    LeaderWorkerSet (4 pods per group) is collected in GROUP units,
+    owner-ref'd to the LWS kind, and scaled in whole groups through the
+    real HTTP API — no fractional-host state ever exists server-side."""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_controller import make_prom
+
+    from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+
+    # config CMs (v5e-16 costs) + a multi-host VA, NO Deployment: the
+    # workload resolver must fall through to the LeaderWorkerSet
+    seed_config(server, accelerator="v5e-16", model="meta/llama-3.1-70b")
+    doc = make_va_doc(name="llama-70b", model="meta/llama-3.1-70b")
+    doc["metadata"]["labels"]["inference.optimization/acceleratorName"] = "v5e-16"
+    doc["spec"]["modelProfile"]["accelerators"][0]["acc"] = "v5e-16"
+    post(server, f"/apis/llmd.ai/v1alpha1/namespaces/{NS}/variantautoscalings", doc)
+    post(server, f"/apis/leaderworkerset.x-k8s.io/v1/namespaces/{NS}/leaderworkersets", {
+        "metadata": {"name": "llama-70b", "namespace": NS},
+        "spec": {"replicas": 1, "leaderWorkerTemplate": {"size": 4}},
+        "status": {"replicas": 1, "readyReplicas": 1},
+    })
+
+    rec = Reconciler(
+        kube=client, prom=make_prom(arrival_rps=40.0),
+        config=ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar",
+                                direct_scale=True),
+    )
+    report = rec.run_cycle()
+    assert report.errors == [], report.errors
+
+    va = client.get_variant_autoscaling(NS, "llama-70b")
+    desired = va.status.desired_optimized_alloc.num_replicas
+    assert desired > 1
+    # current replicas were read in GROUP units (1 group, not 4 pods)
+    assert va.status.current_alloc.num_replicas == 1
+    # the LWS behind real HTTP was scaled in whole groups
+    lws = client.get_leader_worker_set(NS, "llama-70b")
+    assert lws["spec"]["replicas"] == desired
+    assert lws["spec"]["leaderWorkerTemplate"]["size"] == 4  # untouched
+    # owner reference names the LWS kind, not Deployment
+    assert va.owner_references and va.owner_references[0]["kind"] == "LeaderWorkerSet"
