@@ -249,6 +249,20 @@ def _bucket_k(cap: int) -> int:
     return k
 
 
+def pad_params_rows(params, total: int):
+    """Pad every array of a params pytree to `total` rows by repeating row
+    0 (dummy lanes) — the one padding rule shared by the fused dispatch
+    and the sharding layout (tests pin it)."""
+    n = len(np.asarray(params[0]))
+    pad = total - n
+    if pad <= 0:
+        return params
+    return type(params)(
+        *(np.concatenate([np.asarray(a), np.repeat(np.asarray(a)[:1], pad, axis=0)])
+          for a in params)
+    )
+
+
 def _pad_lanes(n: int, chunk: int) -> int:
     """Pad a bucket's lane count to the next power of two (>= 8), then to a
     multiple of the mesh chunk. The fused multi-bucket program's jit cache
@@ -331,16 +345,13 @@ def _solve_all(
         for k_bucket, idx_list in sorted(buckets.items()):
             idx = np.asarray(idx_list)
             sub = cls(*(a[idx] for a in params_np))
-            pad = _pad_lanes(len(idx), chunk) - len(idx)
-            if pad:
-                sub = cls(
-                    *(np.concatenate([a, np.repeat(a[:1], pad, axis=0)]) for a in sub)
-                )
+            width = _pad_lanes(len(idx), chunk)
+            sub = pad_params_rows(sub, width)
             if mesh is not None:
                 sub = shard_fleet_params(sub, mesh)
             subs.append(sub)
             specs.append((kind, k_bucket))
-            slots.append((kind, idx, len(idx) + pad))
+            slots.append((kind, idx, width))
 
     agg_out = tan_out = None
     if plan is not None and plan.num_lanes:

@@ -15,6 +15,8 @@ import urllib.request
 
 import pytest
 
+from test_controller import make_prom  # tests dir is importable (conftest)
+
 from inferno_tpu.controller.kube import Conflict, NotFound, RestKubeClient
 from inferno_tpu.controller.leader import LeaderElector
 from inferno_tpu.controller.watch import Watcher
@@ -309,10 +311,6 @@ def test_two_instance_process_shape_with_failover(server):
     when the leader releases, the follower takes over and keeps writing
     fresh decisions. (The reference delegates this to controller-runtime's
     manager; here it is this repo's own leader.py/watch.py/run_forever.)"""
-    import sys
-    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
-    from test_controller import make_prom
-
     from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
 
     seed_cluster(server, interval="1s")
@@ -400,10 +398,6 @@ def test_inmemory_cluster_and_apiserver_agree(server, client):
     server must land the same status + scale. Keeps the fake honest —
     drift between the two would silently undermine every test built on
     InMemoryCluster."""
-    import sys
-    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
-    from test_controller import make_prom
-
     from inferno_tpu.controller.kube import InMemoryCluster
     from inferno_tpu.controller.crd import VariantAutoscaling
     from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
@@ -447,10 +441,6 @@ def test_inmemory_cluster_and_apiserver_agree(server, client):
 
 
 def test_run_cycle_scales_real_deployment_over_http(server, client):
-    import sys
-    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
-    from test_controller import make_prom
-
     from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
 
     seed_cluster(server)
@@ -481,10 +471,6 @@ def test_run_cycle_scales_lws_groups_over_http(server, client):
     LeaderWorkerSet (4 pods per group) is collected in GROUP units,
     owner-ref'd to the LWS kind, and scaled in whole groups through the
     real HTTP API — no fractional-host state ever exists server-side."""
-    import sys
-    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
-    from test_controller import make_prom
-
     from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
 
     # config CMs (v5e-16 costs) + a multi-host VA, NO Deployment: the

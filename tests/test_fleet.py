@@ -246,3 +246,33 @@ def test_tandem_no_prefill_stage_excluded():
     scalar = _scalar_system(spec)
     for name, server in fleet.servers.items():
         assert server.all_allocations == scalar.servers[name].all_allocations == {}
+
+
+def test_fleet_mesh_and_sharding_layout():
+    """Mesh construction + lane-axis sharding facts: 8 virtual devices,
+    each holding exactly lanes/8 rows of every FleetParams array."""
+    from jax.sharding import NamedSharding
+
+    from inferno_tpu.parallel.fleet import pad_params_rows
+    from inferno_tpu.parallel.mesh import FLEET_AXIS, shard_fleet_params
+
+    mesh = fleet_mesh()
+    assert mesh.shape == {FLEET_AXIS: 8}
+    sub = fleet_mesh(n_devices=4)
+    assert sub.shape == {FLEET_AXIS: 4}
+
+    spec = _spec_multi()
+    system = System(spec)
+    plan = build_fleet(system)
+    n = plan.num_lanes
+    total = n + ((-n) % 8)
+    padded = pad_params_rows(plan.params, total)
+    sharded = shard_fleet_params(padded, mesh)
+    for arr in sharded:
+        assert isinstance(arr.sharding, NamedSharding)
+        assert arr.shape[0] == total
+        shard_rows = {s.data.shape[0] for s in arr.addressable_shards}
+        assert shard_rows == {total // 8}  # even split, no replication
+    # device set covers the whole mesh
+    devs = {s.device for s in sharded.alpha.addressable_shards}
+    assert len(devs) == 8
