@@ -97,3 +97,47 @@ def test_concurrent_system_cycles_are_independent():
     for t in threads:
         t.join()
     assert all(r == expected for r in results)
+
+
+def test_concurrent_full_optimizations_are_independent():
+    """Whole optimization cycles (sizing + solve + pool accounting) on
+    DISTINCT System objects from many threads must match the serial
+    results exactly — the no-package-globals guarantee at the widest
+    scope (the reference's TheSystem singleton forbids this,
+    pkg/core/system.go:10-45, pkg/manager/manager.go:14)."""
+    import numpy as np
+
+    from fixtures import make_server, make_system_spec
+    from inferno_tpu.core import System
+    from inferno_tpu.solver import optimize
+
+    specs = [
+        make_system_spec([
+            make_server(name=f"t{i}-a", arrival_rate=300.0 + 137.0 * i),
+            make_server(name=f"t{i}-b", class_name="Freemium",
+                        arrival_rate=2000.0 + 61.0 * i, out_tokens=64),
+        ])
+        for i in range(8)
+    ]
+    serial = [
+        {k: v.num_replicas for k, v in optimize(System(s)).solution.items()}
+        for s in specs
+    ]
+
+    results = [None] * len(specs)
+    errors = []
+
+    def run(i):
+        try:
+            sol = optimize(System(specs[i])).solution
+            results[i] = {k: v.num_replicas for k, v in sol.items()}
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(len(specs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert errors == []
+    assert results == serial
