@@ -105,10 +105,6 @@ def test_concurrent_full_optimizations_are_independent():
     results exactly — the no-package-globals guarantee at the widest
     scope (the reference's TheSystem singleton forbids this,
     pkg/core/system.go:10-45, pkg/manager/manager.go:14)."""
-    import numpy as np
-
-    from fixtures import make_server, make_system_spec
-    from inferno_tpu.core import System
     from inferno_tpu.solver import optimize
 
     specs = [
@@ -139,5 +135,6 @@ def test_concurrent_full_optimizations_are_independent():
         t.start()
     for t in threads:
         t.join(30)
+    assert not any(t.is_alive() for t in threads), "optimize hung under concurrency"
     assert errors == []
     assert results == serial
