@@ -297,3 +297,36 @@ def test_low_load_service_time_exact_on_large_grids():
     # tiny genuine mass sits at n=2 (rel ~2e-5); the subtractive-form bug
     # was a 35% error, so 1e-4 discriminates with orders to spare
     assert s.avg_serv_time == pytest.approx(t1, rel=1e-4)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_sizing_inverts_to_target_random_profiles(seed):
+    """Bisection accuracy sweep: at the TTFT-binding rate the tail-TTFT
+    evaluator returns (approximately) the target, and at the ITL-binding
+    rate the ITL evaluator does — for random profiles whose targets fall
+    strictly inside the achievable range."""
+    rng = np.random.default_rng(seed)
+    dec = DecodeParms(alpha=float(rng.uniform(5, 25)), beta=float(rng.uniform(0.1, 0.6)))
+    pre = PrefillParms(gamma=float(rng.uniform(1, 8)), delta=float(rng.uniform(0.005, 0.05)))
+    req = RequestSize(avg_in_tokens=int(rng.integers(32, 512)),
+                      avg_out_tokens=int(rng.integers(16, 128)))
+    an = build_analyzer(max_batch=int(rng.integers(4, 32)), max_queue=160,
+                        decode=dec, prefill=pre, request=req)
+    # targets strictly inside the curve's range at both bounds
+    ttft_lo = an._tail_ttft_at(an.lambda_min)
+    ttft_hi = an._tail_ttft_at(an.lambda_max)
+    itl_lo = an._itl_at(an.lambda_min)
+    itl_hi = an._itl_at(an.lambda_max)
+    t_ttft = ttft_lo + 0.4 * (ttft_hi - ttft_lo)
+    t_itl = itl_lo + 0.4 * (itl_hi - itl_lo)
+
+    rates, metrics, _ = an.size(TargetPerf(target_ttft=t_ttft, target_itl=t_itl))
+    lam_ttft = rates.rate_target_ttft / 1000.0
+    lam_itl = rates.rate_target_itl / 1000.0
+    assert an._tail_ttft_at(lam_ttft) == pytest.approx(t_ttft, rel=1e-3)
+    assert an._itl_at(lam_itl) == pytest.approx(t_itl, rel=1e-3)
+    # the returned operating point IS the one at the binding minimum
+    binding = min(lam_ttft, lam_itl, an.lambda_max * (1 - 0.0))
+    expect = an.analyze(binding * 1000.0)
+    assert metrics.throughput == pytest.approx(expect.throughput, rel=1e-9)
+    assert metrics.ttft == pytest.approx(expect.ttft, rel=1e-9)
