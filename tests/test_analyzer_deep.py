@@ -326,7 +326,8 @@ def test_sizing_inverts_to_target_random_profiles(seed):
     assert an._tail_ttft_at(lam_ttft) == pytest.approx(t_ttft, rel=1e-3)
     assert an._itl_at(lam_itl) == pytest.approx(t_itl, rel=1e-3)
     # the returned operating point IS the one at the binding minimum
-    binding = min(lam_ttft, lam_itl, an.lambda_max * (1 - 0.0))
+    # (no TPS target here, so no stability-headroom clamp applies)
+    binding = min(lam_ttft, lam_itl)
     expect = an.analyze(binding * 1000.0)
     assert metrics.throughput == pytest.approx(expect.throughput, rel=1e-9)
     assert metrics.ttft == pytest.approx(expect.ttft, rel=1e-9)

@@ -343,3 +343,32 @@ def test_helm_templates_structurally_sound():
         assert depth == 0, f"{fname}: {depth} unclosed control block(s)"
     missing = included - defined
     assert not missing, f"include of undefined template(s): {missing}"
+
+
+def test_remaining_samples_parse_and_reference_real_series():
+    """Every shipped sample parses; the HPA/KEDA/adapter samples must
+    reference metric series the controller actually emits."""
+    from inferno_tpu.controller.engines import (
+        METRIC_DESIRED_RATIO,
+        METRIC_DESIRED_REPLICAS,
+    )
+
+    samples = os.path.join(REPO, "deploy/samples")
+    for name in os.listdir(samples):
+        docs = load_all(os.path.join(samples, name))
+        assert docs, name
+
+    with open(os.path.join(samples, "hpa-integration.yaml")) as f:
+        hpa_text = f.read()
+    assert METRIC_DESIRED_REPLICAS in hpa_text
+    assert any(d.get("kind") == "HorizontalPodAutoscaler"
+               for d in yaml.safe_load_all(hpa_text) if d)
+
+    with open(os.path.join(samples, "keda-scaledobject.yaml")) as f:
+        keda_text = f.read()
+    assert METRIC_DESIRED_REPLICAS in keda_text or METRIC_DESIRED_RATIO in keda_text
+
+    adapter = load_all(os.path.join(samples, "prometheus-adapter-values.yaml"))[0]
+    queries = [r["seriesQuery"] for r in adapter["rules"]["external"]]
+    assert any(METRIC_DESIRED_REPLICAS in q for q in queries)
+    assert any(METRIC_DESIRED_RATIO in q for q in queries)
