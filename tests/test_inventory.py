@@ -89,3 +89,36 @@ def test_unlimited_mode_skips_inventory():
     optimizer, capacity = rec.read_optimizer_and_capacity()
     assert optimizer.unlimited
     assert capacity.chips == {}
+
+
+def test_unschedulable_and_malformed_nodes_skipped():
+    from inferno_tpu.controller.inventory import (
+        collect_tpu_inventory,
+        node_tpu_chips,
+    )
+
+    class K:
+        @staticmethod
+        def list_nodes():
+            return [
+                # cordoned: must not count
+                {"metadata": {"labels": {
+                    "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"}},
+                 "spec": {"unschedulable": True},
+                 "status": {"allocatable": {"google.com/tpu": "4"}}},
+                # garbage chip count -> 0
+                {"metadata": {"labels": {
+                    "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"}},
+                 "status": {"allocatable": {"google.com/tpu": "not-a-number"}}},
+                # TPU chips but no accelerator label -> unattributable, skip
+                {"metadata": {"labels": {}},
+                 "status": {"allocatable": {"google.com/tpu": "4"}}},
+                # healthy
+                {"metadata": {"labels": {
+                    "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"}},
+                 "status": {"allocatable": {"google.com/tpu": "8"}}},
+            ]
+
+    cap = collect_tpu_inventory(K())
+    assert cap.chips == {"v5e": 8}
+    assert node_tpu_chips({"status": {"allocatable": {"google.com/tpu": None}}}) == 0
