@@ -31,11 +31,11 @@ E2E_WINDOW = 3.0
 E2E_SCRAPE = 0.2
 
 
-@pytest.fixture()
-def e2e_stack():
+def make_e2e_stack(engine: str = "vllm-tpu"):
     """Emulated engine HTTP server -> MiniProm scrape -> HttpPromClient ->
-    reconciler with direct-scale actuation, torn down in order. Shared by
-    the sockets-e2e suites (test_e2e_http, test_e2e_sharegpt)."""
+    reconciler with direct-scale actuation. Returns
+    (srv, prom, cluster, rec, teardown); `engine` selects the metric
+    vocabulary end to end (server exposition AND collector queries)."""
     from inferno_tpu.controller.promclient import HttpPromClient, PromConfig
     from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
     from inferno_tpu.emulator.engine import EngineProfile
@@ -46,7 +46,7 @@ def e2e_stack():
     srv = EmulatorServer(
         model_id=MODEL,
         profile=EngineProfile(alpha=18.0, beta=0.3, gamma=5.0, delta=0.02, max_batch=64),
-        engine_name="vllm-tpu",
+        engine_name=engine,
         time_scale=E2E_TIME_SCALE,
     )
     srv.start()
@@ -66,8 +66,20 @@ def e2e_stack():
             config_namespace=CFG_NS,
             compute_backend="scalar",
             direct_scale=True,
+            engine=engine,
         ),
     )
+
+    def teardown():
+        prom.stop()
+        srv.stop()
+
+    return srv, prom, cluster, rec, teardown
+
+
+@pytest.fixture()
+def e2e_stack():
+    """Shared sockets-e2e stack (vLLM-TPU vocabulary); see make_e2e_stack."""
+    srv, prom, cluster, rec, teardown = make_e2e_stack()
     yield srv, prom, cluster, rec
-    prom.stop()
-    srv.stop()
+    teardown()

@@ -369,3 +369,35 @@ def test_remaining_samples_parse_and_reference_real_series():
     queries = [r["seriesQuery"] for r in adapter["rules"]["external"]]
     assert any(METRIC_DESIRED_REPLICAS in q for q in queries)
     assert any(METRIC_DESIRED_RATIO in q for q in queries)
+
+
+def test_condition_reasons_documented():
+    """Every condition type/reason constant the controller can set must
+    appear in the metrics-health runbook's table — the operator-facing
+    contract (docs/metrics-health-monitoring.md)."""
+    import inferno_tpu.controller.crd as crd
+
+    doc = open(os.path.join(REPO, "docs/metrics-health-monitoring.md")).read()
+    for name in dir(crd):
+        if name.startswith(("REASON_", "TYPE_")):
+            value = getattr(crd, name)
+            if not isinstance(value, str):
+                continue  # e.g. typing.TYPE_CHECKING imported later
+            assert value in doc, f"{name} ({value}) missing from the runbook"
+
+
+def test_env_knobs_documented_in_user_guide():
+    """Every env knob the controller entrypoint actually READS (from its
+    source, not its prose) must appear in the user-guide configuration
+    table — the 'same commit' convention from the developer guide."""
+    import re
+
+    import inferno_tpu.controller.main as M
+
+    src = open(M.__file__).read()
+    pattern = r'(?:env_bool|os\.environ\.get)\(\s*"([A-Z][A-Z0-9_]+)"'
+    knobs = set(re.findall(pattern, src))
+    assert len(knobs) >= 10, f"source parse produced too little: {sorted(knobs)}"
+    guide = open(os.path.join(REPO, "docs/user-guide/configuration.md")).read()
+    for knob in sorted(knobs):
+        assert knob in guide, f"{knob} missing from configuration.md"

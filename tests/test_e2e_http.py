@@ -379,25 +379,9 @@ def test_jetstream_engine_full_cycle_over_sockets():
     the jetstream vocabulary (SERVING_ENGINE=jetstream) -> scale-out.
     Pins that the engine-pluggable path works over real sockets, not just
     in exposition unit tests."""
-    srv = EmulatorServer(
-        model_id=MODEL,
-        profile=EngineProfile(alpha=18.0, beta=0.3, gamma=5.0, delta=0.02, max_batch=64),
-        engine_name="jetstream",
-        time_scale=TIME_SCALE,
-    )
-    srv.start()
-    prom = MiniProm(
-        [(f"http://127.0.0.1:{srv.port}/metrics", {"namespace": NS})],
-        scrape_interval=SCRAPE, window_seconds=WINDOW,
-    )
-    prom.start()
-    cluster = make_cluster(replicas=1)
-    rec = Reconciler(
-        kube=cluster,
-        prom=HttpPromClient(PromConfig(base_url=prom.url, allow_http=True)),
-        config=ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar",
-                                direct_scale=True, engine="jetstream"),
-    )
+    from conftest import make_e2e_stack
+
+    srv, prom, cluster, rec, teardown = make_e2e_stack(engine="jetstream")
     try:
         _post_load(srv.port, duration_s=2.0)
         time.sleep(2 * SCRAPE)
@@ -411,5 +395,4 @@ def test_jetstream_engine_full_cycle_over_sockets():
         # max batch came from the engine-reported jetstream_total_slots
         assert va.status.current_alloc.max_batch == 64
     finally:
-        prom.stop()
-        srv.stop()
+        teardown()
