@@ -357,3 +357,52 @@ def test_e2e_observed_itl_matches_profile():
         assert itl == pytest.approx(5.8, rel=0.5)
     finally:
         engine.stop()
+
+
+def test_http_server_edge_cases():
+    """HTTP surface robustness: malformed JSON -> 400, unknown paths ->
+    404, health endpoints, usage accounting in the completion body."""
+    srv = EmulatorServer(model_id=MODEL, profile=FAST, time_scale=0.002)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        # health endpoints
+        for path in ("/health", "/healthz"):
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                assert r.read() == b"ok"
+        # unknown GET and POST paths
+        for method, path in (("GET", "/nope"), ("POST", "/v1/completions")):
+            req = urllib.request.Request(base + path, method=method,
+                                         data=b"{}" if method == "POST" else None)
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        # malformed body -> 400
+        req = urllib.request.Request(
+            base + "/v1/chat/completions", data=b"{not json",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        # empty body falls back to defaults and still completes
+        req = urllib.request.Request(base + "/v1/chat/completions", data=b"")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            doc = json.loads(r.read())
+        assert doc["usage"]["prompt_tokens"] >= 1
+        assert doc["usage"]["completion_tokens"] == 64  # default max_tokens
+        assert doc["model"] == MODEL
+        # explicit token counts are echoed in usage
+        body = json.dumps({"messages": [{"role": "user", "content": "a b c d"}],
+                           "max_tokens": 7}).encode()
+        req = urllib.request.Request(base + "/v1/chat/completions", data=body)
+        with urllib.request.urlopen(req, timeout=30) as r:
+            doc = json.loads(r.read())
+        assert doc["usage"] == {"prompt_tokens": 4, "completion_tokens": 7,
+                                "total_tokens": 11}
+    finally:
+        srv.stop()
