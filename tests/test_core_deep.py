@@ -433,3 +433,34 @@ def test_allocation_diff_none_cases():
     empty = Allocation(accelerator="", num_replicas=0, batch_size=0, cost=0.0)
     d2 = allocation_diff(empty, b)
     assert d2.old_accelerator == "none"
+
+
+# -- spec validation gates ---------------------------------------------------
+
+
+def test_validation_gates_reject_bad_specs():
+    """The validate() gates the analyzers call before touching math: bad
+    wire data fails with a named error, not NaNs downstream."""
+    from inferno_tpu.analyzer import AnalyzerError, build_analyzer, build_disagg_analyzer
+    from inferno_tpu.analyzer.queue import RequestSize, TargetPerf
+
+    dec, pre = DecodeParms(alpha=5.0, beta=0.1), PrefillParms(gamma=1.0, delta=0.01)
+
+    with pytest.raises(ValueError):
+        DisaggSpec(prefill_slices=0).validate()
+    with pytest.raises(ValueError):
+        DisaggSpec(prefill_max_batch=-1).validate()
+    DisaggSpec().validate()  # defaults are valid
+
+    with pytest.raises(AnalyzerError):
+        build_analyzer(max_batch=8, max_queue=80, decode=dec, prefill=pre,
+                       request=RequestSize(avg_in_tokens=-1, avg_out_tokens=8))
+    with pytest.raises(AnalyzerError):
+        build_analyzer(max_batch=8, max_queue=80, decode=dec, prefill=pre,
+                       request=RequestSize(avg_in_tokens=8, avg_out_tokens=0))
+    with pytest.raises(AnalyzerError):
+        build_disagg_analyzer(max_batch=8, max_queue=80, decode=dec, prefill=pre,
+                              request=RequestSize(avg_in_tokens=8, avg_out_tokens=8),
+                              spec=DisaggSpec(decode_slices=0))
+    with pytest.raises(AnalyzerError):
+        TargetPerf(target_ttft=-1.0).validate()

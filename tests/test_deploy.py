@@ -390,7 +390,6 @@ def test_env_knobs_documented_in_user_guide():
     """Every env knob the controller PACKAGE actually READS (from source,
     not prose) must appear in the user-guide configuration table — the
     'same commit' convention from the developer guide."""
-    import glob as _glob
     import re
 
     import inferno_tpu.controller as C
@@ -398,7 +397,7 @@ def test_env_knobs_documented_in_user_guide():
     pkg_dir = os.path.dirname(C.__file__)
     pattern = r'(?:env_bool|os\.environ\.get)\(\s*"([A-Z][A-Z0-9_]+)"'
     knobs = set()
-    for path in _glob.glob(os.path.join(pkg_dir, "*.py")):
+    for path in glob.glob(os.path.join(pkg_dir, "*.py")):
         with open(path) as f:
             knobs |= set(re.findall(pattern, f.read()))
     # platform-injected, not operator configuration

@@ -8,6 +8,7 @@ scale-in at idle, CR status consistent with emitted gauges.
 
 import json
 import time
+import urllib.error
 import urllib.request
 
 import pytest
