@@ -158,6 +158,84 @@ def size_model_shapes(model: str) -> dict:
     return per_shape
 
 
+def ici_sensitivity(chosen_acc: str, a100_usd: float) -> dict | None:
+    """How much modeling risk the headline carries when it rests on a
+    DERIVED multi-chip profile (round-3 verdict missing #1): re-derive the
+    chosen shape's parms from the committed raw measurement with the
+    analytic ICI all-reduce cost scaled by m, re-size, and report the
+    $/Mtok row per m plus the break-even multiplier where the TPU
+    advantage evaporates (vs_baseline < 1). m=0 is free ICI (full-overlap
+    limit); m=1 the base unoverlapped model; m>1 congestion/inefficiency."""
+    import json as _json
+    from pathlib import Path
+
+    from inferno_tpu.models.profiles import PROFILES_DIR, fit_tpu_profile
+
+    prof_doc = _json.loads(
+        (PROFILES_DIR / f"llama-3.1-8b_{chosen_acc}.json").read_text()
+    )
+    if not prof_doc.get("derived"):
+        return None  # headline is a pure measurement; no derivation risk
+    n_chips = int(prof_doc["assumptions"]["n_chips"])
+    wbytes = float(prof_doc["assumptions"]["weight_bytes_per_param"])
+    raw_name = "llama-3.1-8b_tpu_int8.json" if wbytes == 1.0 else "llama-3.1-8b_tpu.json"
+    raw_path = PROFILES_DIR / "raw" / raw_name
+    if not raw_path.exists():
+        return None
+    raw = _json.loads(raw_path.read_text())
+    max_batch = int(prof_doc["maxBatchSize"])  # memory cap: ICI-independent
+
+    cache: dict[float, float | None] = {}
+
+    def usd_at(m: float) -> float | None:
+        """$/Mtok at ICI-cost multiplier m; None when the shape becomes
+        SLO-infeasible (strictly worse than any finite cost). Memoized —
+        each call is a full refit + sizing solve."""
+        if m not in cache:
+            fitted, _ = fit_tpu_profile(raw, n_chips=n_chips, ici_cost_multiplier=m)
+            try:
+                cache[m] = usd_per_mtok(fitted.decode, fitted.prefill, max_batch,
+                                        n_chips * V5E_CHIP_HR)["usd_per_mtok"]
+            except AnalyzerError:
+                cache[m] = None
+        return cache[m]
+
+    def beats_baseline(m: float) -> bool:
+        usd = usd_at(m)
+        return usd is not None and usd < a100_usd
+
+    rows = {
+        str(m): (round(usd, 4) if (usd := usd_at(m)) is not None else None)
+        for m in (0.0, 0.5, 1.0, 2.0, 4.0, 8.0)
+    }
+    # bisect the multiplier where the TPU stops beating the A100 baseline
+    # (usd_at is increasing in m); cap the search at 256x the base model.
+    # Strict-JSON values only: null = never wins, ">256" = wins everywhere
+    # searched (json.dumps would otherwise emit the non-standard Infinity).
+    lo, hi = 1.0, 256.0
+    break_even: float | str | None = None
+    if beats_baseline(lo):
+        if beats_baseline(hi):
+            break_even = ">256"
+        else:
+            # 20 iterations: hi-lo < 256/2^20, far below the 2-decimal output
+            for _ in range(20):
+                mid = (lo + hi) / 2
+                if beats_baseline(mid):
+                    lo = mid
+                else:
+                    hi = mid
+            break_even = round((lo + hi) / 2, 2)
+    return {
+        "usd_per_mtok_at_multiplier": rows,
+        "break_even_multiplier": break_even,
+        "note": (
+            "headline survives until the modeled (already-unoverlapped) "
+            "ICI all-reduce cost is wrong by this factor"
+        ),
+    }
+
+
 def north_star() -> dict:
     per_shape = size_model_shapes("llama-3.1-8b")
     if not per_shape:
@@ -184,6 +262,25 @@ def north_star() -> dict:
     # $/Mtok is linear in the price constant: the fixture-cost sensitivity
     # is a rescale, not another sizing solve
     a100_fixture_usd = a100["usd_per_mtok"] * (A100_FIXTURE_HR / A100_HR)
+
+    # Batch-parity row (round-3 verdict weak #3): the A100 side is capped
+    # at max_batch=64 because that is what the reference MEASURED
+    # (--max-num-seqs 64); the TPU side's memory-derived cap is larger.
+    # Report the TPU headline shape re-sized with the same 64 cap so the
+    # asymmetry is visible in the JSON, not only in source.
+    tpu_prof = tpu["profile"] if "profile" in tpu else None
+    batch64 = None
+    if tpu_prof and tpu_prof["max_batch"] > 64:
+        try:
+            batch64 = round(usd_per_mtok(
+                DecodeParms(alpha=tpu_prof["alpha"], beta=tpu_prof["beta"]),
+                PrefillParms(gamma=tpu_prof["gamma"], delta=tpu_prof["delta"]),
+                64, tpu_prof["chips"] * V5E_CHIP_HR,
+            )["usd_per_mtok"], 4)
+        except AnalyzerError:
+            batch64 = None
+
+    ici = ici_sensitivity(best_acc, a100["usd_per_mtok"])
     return {
         "tpu": tpu,
         "chosen_shape": best_acc,
@@ -199,6 +296,27 @@ def north_star() -> dict:
             "workload": {"in": REQ.avg_in_tokens, "out": REQ.avg_out_tokens,
                          "arrival_rps": ARRIVAL_RPS},
             "costs_usd_hr": {"v5e_chip": V5E_CHIP_HR, "a100": A100_HR},
+            **({"ici_efficiency": ici} if ici else {}),
+            **({"tpu_capped_at_batch64_usd_per_mtok": batch64}
+               if batch64 is not None else {}),
+            "caveats": {
+                "batch_asymmetry": (
+                    "A100 max_batch=64 is the reference's own measured "
+                    "config (--max-num-seqs 64, parameter-estimation.md); "
+                    "the TPU cap is memory-derived and larger — see "
+                    "tpu_capped_at_batch64_usd_per_mtok for the TPU side "
+                    "re-sized at the same 64 cap"
+                ),
+                "int8_quality": (
+                    "the TPU headline serves int8 weights (w8a16); "
+                    "weight-only int8 on 8B-class models holds quality "
+                    "within ~1% of bf16 on standard evals (e.g. MMLU; see "
+                    "docs/design/profiling-methodology.md 'int8 quality'), "
+                    "while the A100 baseline was measured at fp16 — the "
+                    "bf16-compute v5e-4 row ($/Mtok above) is the "
+                    "dtype-parity comparison"
+                ),
+            },
         },
     }
 
@@ -331,11 +449,25 @@ def fleet_cycle_metrics(full: bool = True) -> dict:
 
     import jax
 
+    # What a controller deployed with the default compute_backend="auto"
+    # would actually run here: tpu when the device is reachable, else the
+    # C++ native solver (reconciler.resolve_compute_backend) — so the
+    # production-relevant timing below is explicit, not inferred.
+    platform = jax.default_backend()
+    selected = "tpu" if platform == "tpu" else (
+        "native" if native_ms is not None else "scalar"
+    )
     out = {
         # which platform the jitted fleet path actually ran on: the batched
         # XLA program is designed for TPU (r02 measured ~100 ms there); on
         # a CPU fallback the C++ backend is the intended fast path
-        "platform": jax.default_backend(),
+        "platform": platform,
+        # the backend compute_backend="auto" (the default) selects in this
+        # environment, and its per-cycle timing — the production number
+        "auto_selected_backend": selected,
+        "auto_selected_ms": round(
+            {"tpu": tpu_ms, "native": native_ms or scalar_ms,
+             "scalar": scalar_ms}[selected], 3),
         # the one-sync latency floor: tpu_ms = this + ~15ms host work; the
         # kernel itself is sub-millisecond (device-resident inputs measure
         # ~= the floor), so on a co-located TPU host the cycle is ~16ms

@@ -27,6 +27,15 @@ case "${ENVIRONMENT}" in
     kubectl apply -k "${SCRIPT_DIR}/manifests"
     kubectl create namespace workloads --dry-run=client -o yaml | kubectl apply -f -
     kubectl apply -f "${SCRIPT_DIR}/samples/emulator-deployment.yaml"
+    # the ServiceMonitor needs the prometheus-operator CRD; a bare kind
+    # cluster without kube-prometheus would reject it and abort the install
+    if kubectl api-resources --api-group=monitoring.coreos.com 2>/dev/null \
+        | grep -q servicemonitors; then
+      kubectl apply -f "${SCRIPT_DIR}/samples/emulator-servicemonitor.yaml"
+    else
+      echo "prometheus-operator CRDs absent; skipping ServiceMonitor" \
+           "(apply samples/emulator-servicemonitor.yaml after installing kube-prometheus)"
+    fi
     kubectl apply -f "${SCRIPT_DIR}/samples/variantautoscaling-v5e.yaml"
     echo "emulated stack deployed; point PROMETHEUS_BASE_URL at your"
     echo "Prometheus (kube-prometheus) and apply samples/hpa-integration.yaml"

@@ -52,7 +52,18 @@ fi
 kubectl proxy --port=8001 >/dev/null 2>&1 &
 PROXY_PID=$!
 trap 'kill ${PROXY_PID} 2>/dev/null || true' EXIT
-sleep 2
+# poll until the proxy actually serves (a fixed sleep raced slow CI
+# runners: the node-status PATCH below would hit a dead socket)
+for _ in $(seq 1 30); do
+  if curl -sf "http://127.0.0.1:8001/api" >/dev/null 2>&1; then
+    break
+  fi
+  sleep 1
+done
+if ! curl -sf "http://127.0.0.1:8001/api" >/dev/null 2>&1; then
+  echo "kubectl proxy did not become ready on :8001" >&2
+  exit 1
+fi
 
 for node in $(kubectl get nodes -o name | grep -v control-plane); do
   node_name="${node#node/}"

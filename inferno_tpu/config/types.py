@@ -193,6 +193,62 @@ class DisaggSpec:
         )
 
 
+def select_bucket(buckets, avg_in_tokens: float):
+    """THE context-bucket resolution rule, shared by the config-side
+    `ModelPerfSpec.at_context` and the CRD-side
+    `AcceleratorProfile.bucket_for` (controller/crd.py): the smallest
+    bucket covering the observed average input length, or None when none
+    applies. Works on any objects with a `max_in_tokens` attribute."""
+    if avg_in_tokens <= 0:
+        return None
+    eligible = [b for b in buckets if b.max_in_tokens >= avg_in_tokens]
+    if not eligible:
+        return None
+    return min(eligible, key=lambda b: b.max_in_tokens)
+
+
+@dataclasses.dataclass(frozen=True)
+class ContextBucketSpec:
+    """Latency parms refit at a context-length bucket. Wire shape matches
+    the CRD's `contextBuckets` entries (controller/crd.py ContextBucket):
+    the sizing-relevant fields round-trip; fit provenance stays in the
+    JSON document (SURVEY §5.7: long context as profile dimensions)."""
+
+    max_in_tokens: int  # bucket upper bound, e.g. 4096 / 16384 / 65536
+    max_batch_size: int = 0  # 0 = inherit the profile's base batch
+    # token count max_batch_size was sized at (KV budget per admitted
+    # request); 0 = fall back to max_in_tokens
+    at_tokens: int = 0
+    decode_parms: DecodeParms = dataclasses.field(default_factory=DecodeParms)
+    prefill_parms: PrefillParms = dataclasses.field(default_factory=PrefillParms)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "maxInTokens": self.max_in_tokens,
+            "maxBatchSize": self.max_batch_size,
+            "atTokens": self.at_tokens,
+            "perfParms": {
+                "decodeParms": {"alpha": self.decode_parms.alpha, "beta": self.decode_parms.beta},
+                "prefillParms": {"gamma": self.prefill_parms.gamma, "delta": self.prefill_parms.delta},
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ContextBucketSpec":
+        pp = d.get("perfParms", {}) or {}
+        dp = pp.get("decodeParms", {}) or {}
+        fp = pp.get("prefillParms", {}) or {}
+        return cls(
+            max_in_tokens=int(d.get("maxInTokens", 0) or 0),
+            max_batch_size=int(d.get("maxBatchSize", 0) or 0),
+            at_tokens=int(d.get("atTokens", 0) or 0),
+            decode_parms=DecodeParms(float(dp.get("alpha", 0.0) or 0.0),
+                                     float(dp.get("beta", 0.0) or 0.0)),
+            prefill_parms=PrefillParms(float(fp.get("gamma", 0.0) or 0.0),
+                                       float(fp.get("delta", 0.0) or 0.0)),
+        )
+
+
 @dataclasses.dataclass
 class ModelPerfSpec:
     """Performance profile of one model on one slice shape
@@ -214,6 +270,32 @@ class ModelPerfSpec:
     # unit of prefill_slices + decode_slices pod-slices of this shape, sized
     # by the tandem model in inferno_tpu.analyzer.disagg.
     disagg: DisaggSpec | None = None
+    # measured long-context buckets, sorted ascending by max_in_tokens;
+    # base parms serve loads beyond the largest bucket
+    context_buckets: list[ContextBucketSpec] = dataclasses.field(default_factory=list)
+
+    def at_context(self, avg_in_tokens: float) -> "ModelPerfSpec":
+        """Resolve to the smallest bucket covering the observed average
+        input length; self unchanged when no bucket applies.
+
+        `at_tokens` must track the bucket's own sizing token count: the
+        downstream K-rescale (batch = max_batch_size * at_tokens / K)
+        assumes at_tokens is the context the cap was computed at — keeping
+        the base value would inflate a long-context cap ~at_tokens-fold."""
+        b = select_bucket(self.context_buckets, avg_in_tokens)
+        if b is None:
+            return self
+        if b.max_batch_size <= 0:
+            return dataclasses.replace(
+                self, decode_parms=b.decode_parms, prefill_parms=b.prefill_parms
+            )
+        return dataclasses.replace(
+            self,
+            decode_parms=b.decode_parms,
+            prefill_parms=b.prefill_parms,
+            max_batch_size=b.max_batch_size,
+            at_tokens=b.at_tokens or b.max_in_tokens,
+        )
 
     def to_dict(self) -> dict[str, Any]:
         out = {
@@ -227,6 +309,8 @@ class ModelPerfSpec:
         }
         if self.disagg is not None:
             out["disagg"] = self.disagg.to_dict()
+        if self.context_buckets:
+            out["contextBuckets"] = [b.to_dict() for b in self.context_buckets]
         return out
 
     @classmethod
@@ -244,6 +328,10 @@ class ModelPerfSpec:
             prefill_parms=PrefillParms(float(pp.get("gamma", 0.0)), float(pp.get("delta", 0.0))),
             # `{}` is a valid spec (all defaults); only absent/null disables
             disagg=DisaggSpec.from_dict(dg) if dg is not None else None,
+            context_buckets=sorted(
+                (ContextBucketSpec.from_dict(b) for b in d.get("contextBuckets", []) or []),
+                key=lambda b: b.max_in_tokens,
+            ),
         )
 
 

@@ -22,10 +22,12 @@ import datetime
 from typing import Any, Mapping
 
 from inferno_tpu.config.types import (
+    ContextBucketSpec,
     DecodeParms,
     DisaggSpec,
     ModelPerfSpec,
     PrefillParms,
+    select_bucket,
 )
 
 GROUP = "llmd.ai"
@@ -107,11 +109,15 @@ class ContextBucket:
     decode_parms: DecodeParms = dataclasses.field(default_factory=DecodeParms)
     prefill_parms: PrefillParms = dataclasses.field(default_factory=PrefillParms)
     max_batch_size: int = 0  # 0 = inherit the profile's base batch
+    # token count max_batch_size was sized at (KV budget per admitted
+    # request); 0 = fall back to max_in_tokens
+    at_tokens: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return {
             "maxInTokens": self.max_in_tokens,
             "maxBatchSize": self.max_batch_size,
+            "atTokens": self.at_tokens,
             "perfParms": _perf_parms_to_dict(self.decode_parms, self.prefill_parms),
         }
 
@@ -121,6 +127,7 @@ class ContextBucket:
         return cls(
             max_in_tokens=int(d.get("maxInTokens", 0) or 0),
             max_batch_size=int(d.get("maxBatchSize", 0) or 0),
+            at_tokens=int(d.get("atTokens", 0) or 0),
             decode_parms=decode,
             prefill_parms=prefill,
         )
@@ -145,31 +152,35 @@ class AcceleratorProfile:
     context_buckets: list[ContextBucket] = dataclasses.field(default_factory=list)
 
     def bucket_for(self, avg_in_tokens: float) -> ContextBucket | None:
-        """Smallest bucket covering the observed average input length."""
-        if avg_in_tokens <= 0:
-            return None
-        eligible = [b for b in self.context_buckets if b.max_in_tokens >= avg_in_tokens]
-        if not eligible:
-            return None
-        return min(eligible, key=lambda b: b.max_in_tokens)
+        """Smallest bucket covering the observed average input length
+        (the shared rule: config.types.select_bucket)."""
+        return select_bucket(self.context_buckets, avg_in_tokens)
 
     def to_perf_spec(self, model_id: str, avg_in_tokens: float = 0.0) -> ModelPerfSpec:
-        decode, prefill, batch = self.decode_parms, self.prefill_parms, self.max_batch_size
-        bucket = self.bucket_for(avg_in_tokens)
-        if bucket is not None:
-            decode, prefill = bucket.decode_parms, bucket.prefill_parms
-            if bucket.max_batch_size > 0:
-                batch = bucket.max_batch_size
-        return ModelPerfSpec(
+        """Resolve to the optimizer-side perf spec; bucket resolution
+        (including the at_tokens rebase the K-rescale depends on) is
+        delegated to `ModelPerfSpec.at_context` — ONE implementation."""
+        base = ModelPerfSpec(
             name=model_id,
             acc=self.acc,
             slices_per_replica=self.acc_count,
-            max_batch_size=batch,
-            at_tokens=self.at_tokens or batch,
-            decode_parms=decode,
-            prefill_parms=prefill,
+            max_batch_size=self.max_batch_size,
+            at_tokens=self.at_tokens or self.max_batch_size,
+            decode_parms=self.decode_parms,
+            prefill_parms=self.prefill_parms,
             disagg=self.disagg,
+            context_buckets=[
+                ContextBucketSpec(
+                    max_in_tokens=b.max_in_tokens,
+                    max_batch_size=b.max_batch_size,
+                    at_tokens=b.at_tokens,
+                    decode_parms=b.decode_parms,
+                    prefill_parms=b.prefill_parms,
+                )
+                for b in self.context_buckets
+            ],
         )
+        return base.at_context(avg_in_tokens)
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {

@@ -21,8 +21,10 @@ controller.go:516-582):
   METRICS_TLS_CERT_PATH/KEY_PATH  serve /metrics over TLS, certs reloaded
                                 on rotation; plain HTTP when unset
   HEALTH_PORT                   (default 8081; liveness/readiness probes)
-  COMPUTE_BACKEND               tpu | tpu-pallas | native | scalar (default tpu;
-                                USE_TPU_FLEET=false maps to scalar)
+  COMPUTE_BACKEND               auto | tpu | tpu-pallas | native | scalar
+                                (default auto: tpu if a device is attached,
+                                else native, else scalar — the resolution is
+                                logged; USE_TPU_FLEET=false maps to scalar)
   DIRECT_SCALE                  true|false (default false; HPA otherwise)
   LEADER_ELECT                  true|false (default false; lease-based
                                 election for multi-replica deployments)
@@ -119,7 +121,7 @@ def main() -> int:
         engine=os.environ.get("SERVING_ENGINE", "vllm-tpu"),
         scale_to_zero=env_bool("WVA_SCALE_TO_ZERO"),
         compute_backend=os.environ.get(
-            "COMPUTE_BACKEND", "tpu" if env_bool("USE_TPU_FLEET", True) else "scalar"
+            "COMPUTE_BACKEND", "auto" if env_bool("USE_TPU_FLEET", True) else "scalar"
         ).lower(),
         direct_scale=env_bool("DIRECT_SCALE"),
         profile_correction=env_bool("PROFILE_CORRECTION", True),

@@ -1,3 +1,4 @@
+from inferno_tpu.emulator.disagg import DisaggEngine, DisaggProfile
 from inferno_tpu.emulator.engine import EmulatedEngine, EngineProfile, RequestResult
 from inferno_tpu.emulator.loadgen import (
     SHAREGPT_INPUT,
@@ -10,6 +11,8 @@ from inferno_tpu.emulator.miniprom import MiniProm, MiniPromClient
 from inferno_tpu.emulator.server import EmulatorServer, render_engine_metrics
 
 __all__ = [
+    "DisaggEngine",
+    "DisaggProfile",
     "EmulatedEngine",
     "EngineProfile",
     "RequestResult",

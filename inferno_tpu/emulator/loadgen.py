@@ -123,3 +123,84 @@ class LoadGenerator:
     def join(self, timeout: float | None = None) -> None:
         if self._thread:
             self._thread.join(timeout)
+
+
+class HttpLoadGenerator:
+    """Closed-loop HTTP load against an OpenAI-compatible endpoint —
+    `concurrency` workers each posting the next completion as soon as the
+    previous returns (the reference drives real engines the same way via
+    guidellm concurrency). Usable as a CLI for in-cluster load Jobs:
+
+        python -m inferno_tpu.emulator.loadgen \
+            --url http://engine:8000 --duration 150 --concurrency 6
+    """
+
+    def __init__(self, base_url: str, concurrency: int = 6,
+                 in_words: int = 64, max_tokens: int = 32,
+                 model: str = "m", timeout: float = 30.0):
+        self.url = base_url.rstrip("/") + "/v1/chat/completions"
+        self.concurrency = concurrency
+        self.timeout = timeout
+        import json as _json
+
+        self.body = _json.dumps({
+            "model": model,
+            "messages": [{"role": "user", "content": "x " * in_words}],
+            "max_tokens": max_tokens,
+        }).encode()
+        self.completed = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+
+    def _worker(self, stop_at: float) -> None:
+        import urllib.error
+        import urllib.request
+
+        while time.time() < stop_at:
+            req = urllib.request.Request(
+                self.url, data=self.body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=self.timeout).read()
+                with self._lock:
+                    self.completed += 1
+            except (urllib.error.URLError, OSError):
+                with self._lock:
+                    self.errors += 1
+                time.sleep(1.0)  # engine warming up / transient outage
+
+    def run(self, duration_s: float) -> int:
+        stop_at = time.time() + duration_s
+        threads = [
+            threading.Thread(target=self._worker, args=(stop_at,))
+            for _ in range(self.concurrency)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return self.completed
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="closed-loop HTTP load generator")
+    ap.add_argument("--url", required=True, help="engine base URL")
+    ap.add_argument("--duration", type=float, default=60.0, help="seconds")
+    ap.add_argument("--concurrency", type=int, default=6)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--in-words", type=int, default=64)
+    ap.add_argument("--model", default="m")
+    args = ap.parse_args()
+    gen = HttpLoadGenerator(
+        args.url, concurrency=args.concurrency,
+        in_words=args.in_words, max_tokens=args.max_tokens, model=args.model,
+    )
+    done = gen.run(args.duration)
+    print(f"completed={done} errors={gen.errors}")
+
+
+if __name__ == "__main__":
+    main()

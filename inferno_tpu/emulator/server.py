@@ -28,9 +28,15 @@ class EmulatorServer:
         engine_name: str = "vllm-tpu",
         port: int = 0,
         time_scale: float = 1.0,
+        engine=None,
     ):
+        """`engine` overrides the default aggregated EmulatedEngine with
+        any object sharing its surface — e.g. emulator.disagg.DisaggEngine
+        for a prefill/decode-separated (JetStream-style) replica unit."""
         self.model_id = model_id
-        self.engine = EmulatedEngine(profile or EngineProfile(), time_scale=time_scale)
+        self.engine = engine or EmulatedEngine(
+            profile or EngineProfile(), time_scale=time_scale
+        )
         self.vocab = engine_for(engine_name)
         outer = self
 
@@ -151,6 +157,23 @@ def render_engine_metrics(e: EmulatedEngine, model_id: str, vocab) -> str:
 
 
 def main() -> None:
+    engine = None
+    if os.environ.get("DISAGG", "").lower() in ("1", "true", "yes"):
+        # disaggregated (JetStream-style) replica unit: separate prefill
+        # and decode engine pools coupled by a KV-transfer delay
+        from inferno_tpu.emulator.disagg import DisaggEngine, DisaggProfile
+
+        engine = DisaggEngine(DisaggProfile(
+            alpha=float(os.environ.get("DECODE_ALPHA", "20.0")),
+            beta=float(os.environ.get("DECODE_BETA", "0.4")),
+            gamma=float(os.environ.get("PREFILL_GAMMA", "5.0")),
+            delta=float(os.environ.get("PREFILL_DELTA", "0.02")),
+            prefill_max_batch=int(os.environ.get("PREFILL_MAX_BATCH", "8")),
+            decode_max_batch=int(os.environ.get("MAX_BATCH", "64")),
+            prefill_engines=int(os.environ.get("DISAGG_PREFILL_ENGINES", "1")),
+            decode_engines=int(os.environ.get("DISAGG_DECODE_ENGINES", "1")),
+            kv_transfer_ms=float(os.environ.get("KV_TRANSFER_MS", "2.0")),
+        ))
     profile = EngineProfile(
         alpha=float(os.environ.get("DECODE_ALPHA", "20.0")),
         beta=float(os.environ.get("DECODE_BETA", "0.4")),
@@ -163,6 +186,7 @@ def main() -> None:
         profile=profile,
         engine_name=os.environ.get("ENGINE", "vllm-tpu"),
         port=int(os.environ.get("PORT", "8000")),
+        engine=engine,
     )
     server.start()
     print(f"emulator serving {server.model_id} on :{server.port}")

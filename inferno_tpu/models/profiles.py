@@ -20,7 +20,11 @@ into the `profiles/*.json` the autoscaler and benchmark consume):
    all-reduce cost (2 per layer: post-attention and post-MLP) is added
    analytically from link bandwidth and hop latency. Derived profiles are
    marked `"derived": true` — only the 1-chip profile is a pure
-   measurement; the benchmark's headline uses the measured one.
+   measurement. The benchmark picks the cheapest SLO-feasible shape,
+   which is usually a *derived* multi-chip one; the derivation is
+   cross-checked against published v5e serving numbers and carries an
+   ICI-efficiency sensitivity band (docs/design/profiling-methodology.md,
+   bench.py extra.sensitivity.ici_efficiency).
 
 Profile JSON files are a superset of the `ModelPerfSpec.from_dict` wire
 shape, so a committed profile loads directly into the optimizer.
@@ -213,12 +217,23 @@ def _fit_ttft_anchor(points, anchor_tokens: int = TTFT_ANCHOR_TOKENS):
 def fit_tpu_profile(
     raw: Mapping[str, Any], n_layers_full: int = 32, n_chips: int = 1,
     ici_bw_gbs: float = 45.0, ici_latency_us: float = 1.0,
+    ici_cost_multiplier: float = 1.0,
 ):
     """FittedProfile + synthesis metadata from a raw measurement file.
     `n_chips` > 1 derives a tensor-parallel profile: decode parms via
-    derive_tensor_parallel, TTFT points TP-scaled before fitting."""
+    derive_tensor_parallel, TTFT points TP-scaled before fitting.
+
+    `ici_cost_multiplier` scales the analytic all-reduce cost (bandwidth
+    divided by it, hop latency multiplied): m=1 is the base unoverlapped
+    model, m<1 models overlap/efficiency gains, m>1 congestion/inefficiency.
+    Used for derivation error bars and the bench's break-even sensitivity."""
     from inferno_tpu.models.linear import _fit_line
 
+    if ici_cost_multiplier <= 0:  # free ICI (full overlap limit)
+        ici_bw_gbs, ici_latency_us = 1e15, 0.0
+    else:
+        ici_bw_gbs = ici_bw_gbs / ici_cost_multiplier
+        ici_latency_us = ici_latency_us * ici_cost_multiplier
     decode, _, meta = synthesize_full_model(raw, n_layers_full)
     points, ttft_meta = ttft_points(raw, n_layers_full, decode_pts=decode)
     meta.update(ttft_meta)
@@ -345,6 +360,23 @@ def build_profile_json(
         dims, hbm_per_chip_gb, at_tokens,
         weight_bytes_per_param=weight_bytes_per_param, n_chips=n_chips,
     )
+    error_bars = None
+    if derived:
+        # Derivation error bars: the modeled ICI all-reduce cost is the
+        # only non-measured term, so refit with it halved (overlap /
+        # efficiency optimism) and doubled (congestion pessimism) and
+        # record the parm band. The memory-derived max batch is exact.
+        lo, _ = fit_tpu_profile(raw, n_layers_full, n_chips=n_chips,
+                                ici_cost_multiplier=0.5)
+        hi, _ = fit_tpu_profile(raw, n_layers_full, n_chips=n_chips,
+                                ici_cost_multiplier=2.0)
+        error_bars = {
+            "ici_cost_multiplier_range": [0.5, 2.0],
+            "alpha": [round(lo.decode.alpha, 4), round(hi.decode.alpha, 4)],
+            "beta": [round(lo.decode.beta, 5), round(hi.decode.beta, 5)],
+            "gamma": [round(lo.prefill.gamma, 4), round(hi.prefill.gamma, 4)],
+            "delta": [round(lo.prefill.delta, 7), round(hi.prefill.delta, 7)],
+        }
     return {
         "name": raw["meta"]["model"],
         "acc": acc,
@@ -359,6 +391,7 @@ def build_profile_json(
             **synth_meta,
         },
         "derived": derived,
+        **({"derivationErrorBars": error_bars} if error_bars else {}),
         "assumptions": {
             "n_chips": n_chips,
             "weight_bytes_per_param": weight_bytes_per_param,
@@ -429,6 +462,9 @@ def attach_context_buckets(
         buckets.append({
             "maxInTokens": max_in_tokens,
             "maxBatchSize": max_batch,
+            # the KV budget max_batch was computed at — consumers rescale
+            # batch by at_tokens/K, so this must be the bucket's own value
+            "atTokens": max_in_tokens + 256,
             "perfParms": {
                 "decodeParms": {"alpha": round(decode.alpha, 4),
                                 "beta": round(decode.beta, 5)},

@@ -49,16 +49,14 @@ DEFAULT_BISECT_ITERS = 64  # double precision; deeper than the f32 TPU kernel
 
 
 def _build(lib_path: str) -> None:
-    # drop superseded hashed artifacts so dev trees / wheels don't
-    # accumulate dead libraries (the *.so package-data glob ships them)
-    import glob
+    # Compile to a call-private temp name and os.rename() into the hashed
+    # path (atomic on POSIX): two processes cold-importing the package
+    # concurrently must never CDLL a half-written .so, and a loser's
+    # rename simply overwrites with identical content. The name must be
+    # unique per call, not per process — threads share a pid.
+    import uuid
 
-    for old in glob.glob(os.path.join(_DIR, "libinferno_queueing*.so")):
-        if old != lib_path:
-            try:
-                os.remove(old)
-            except OSError:
-                pass
+    tmp_path = f"{lib_path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
     cmd = [
         "g++",
         "-O3",
@@ -66,11 +64,30 @@ def _build(lib_path: str) -> None:
         "-shared",
         "-fPIC",
         "-o",
-        lib_path,
+        tmp_path,
         _SRC,
         "-pthread",
     ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.rename(tmp_path, lib_path)
+    finally:
+        if os.path.exists(tmp_path):
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass
+    # Only after the current artifact is in place, drop superseded hashed
+    # artifacts (never the one just built) so dev trees / wheels don't
+    # accumulate dead libraries (the *.so package-data glob ships them).
+    import glob
+
+    for old in glob.glob(os.path.join(_DIR, "libinferno_queueing-*.so")):
+        if old != lib_path:
+            try:
+                os.remove(old)
+            except OSError:
+                pass
 
 
 def _load() -> ctypes.CDLL | None:

@@ -351,8 +351,12 @@ def test_e2e_observed_itl_matches_profile():
         for r in reqs:
             assert r.done_event.wait(30)
         comps = [r for _, r in engine.completions]
+        # VIRTUAL timings: wall-clock ones inflate arbitrarily when the
+        # host is loaded (e.g. the full suite running alongside a bench),
+        # which is scheduler noise, not emulator behavior
         itl = sum(
-            (c.latency_ms - c.ttft_ms) / max(c.out_tokens - 1, 1) for c in comps
+            (c.latency_emu_ms - c.ttft_emu_ms) / max(c.out_tokens - 1, 1)
+            for c in comps
         ) / len(comps)
         # full batch of 8: expected decode step ~ alpha + beta*8 = 5.8 ms
         assert itl == pytest.approx(5.8, rel=0.5)

@@ -313,3 +313,33 @@ def test_negative_slope_at_full_batch_rejected():
     tout = native.tandem_size_native(T())
     assert not tout.feasible[0] and tout.num_replicas[0] == 0
     assert np.isfinite(tout.ttft[0]) and np.isfinite(tout.itl[0])
+
+
+def test_build_is_atomic_and_leaves_no_temp(tmp_path):
+    """ADVICE r3: _build compiles to a temp file and renames into the
+    hashed path (atomic on POSIX), and concurrent builders both succeed."""
+    import ctypes
+    import glob
+    import os
+    import threading
+
+    lib_path = native._lib_path()
+    errs = []
+
+    def build():
+        try:
+            native._build(lib_path)
+        except Exception as e:  # noqa: BLE001 - collect for assertion
+            errs.append(e)
+
+    threads = [threading.Thread(target=build) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert os.path.exists(lib_path)
+    assert not glob.glob(f"{lib_path}.tmp.*")
+    # the freshly renamed artifact is a loadable, complete library
+    lib = ctypes.CDLL(lib_path)
+    assert hasattr(lib, "inferno_fleet_size")

@@ -53,3 +53,39 @@ def test_unknown_model_rejected_by_argparse(tmp_path):
     res = run_tool(tmp_path, "--model", "gpt-oss-999b")
     assert res.returncode != 0
     assert "invalid choice" in res.stderr
+
+
+def test_build_profiles_quarantines_memory_infeasible_int8(tmp_path, monkeypatch):
+    """ADVICE r3: an int8 raw that does not fit one chip must never be
+    published as the headline v5e-1 profile — it is quarantined under
+    v5e-1-int8 with maxBatchSize 0, same as the bf16 transparency path."""
+    import importlib.util
+
+    sys.path.insert(0, str(REPO))
+    from tests.test_profiles import fake_raw
+
+    spec = importlib.util.spec_from_file_location(
+        "build_profiles", REPO / "tools/build_profiles.py")
+    bp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bp)
+
+    raw = fake_raw()
+    # a 70B-class dims block: int8 weights alone (~64 GB) exceed one
+    # 16 GB chip, so max_batch_from_memory returns 0 on v5e-1
+    raw["meta"]["dims"] = {
+        "hidden": 8192, "n_heads": 64, "n_kv_heads": 8, "head_dim": 128,
+        "ffn": 28672, "vocab": 128256, "n_layers_full": 80,
+    }
+    raw["meta"]["model"] = "big-70b"
+    raw_dir = tmp_path / "raw"
+    raw_dir.mkdir()
+    (raw_dir / "big-70b_tpu_int8.json").write_text(json.dumps(raw))
+    monkeypatch.setattr(bp, "RAW_DIR", raw_dir)
+
+    built = bp.build_model("big-70b")
+    assert "big-70b_v5e-1.json" not in built
+    quarantined = built["big-70b_v5e-1-int8.json"]
+    assert quarantined["maxBatchSize"] == 0
+    assert quarantined["acc"] == "v5e-1-int8"
+    # derived multi-chip int8 shapes are still produced (weights fit there)
+    assert built["big-70b_v5e-8-int8.json"]["maxBatchSize"] > 0

@@ -8,9 +8,11 @@ Inputs (written by tools/profile_tpu.py on the real chip):
 Outputs per model:
   <model>_v5e-1.json        MEASURED single-chip profile from the best
       memory-feasible raw (int8 preferred; bf16 when it fits — e.g. a 3B
-      fits one 16 GB chip in bf16, an 8B does not).
-  <model>_v5e-1-bf16.json   MEASURED bf16 reference point when bf16 does
-      NOT fit one chip (maxBatchSize 0; kept for fit transparency).
+      fits one 16 GB chip in bf16, an 8B does not). Not emitted when no
+      raw is memory-feasible on one chip.
+  <model>_v5e-1-bf16.json / _v5e-1-int8.json   MEASURED transparency
+      points when that dtype does NOT fit one chip (maxBatchSize 0,
+      quarantined; never the headline).
   <model>_v5e-4.json / _v5e-8.json            DERIVED TP shapes from the
       bf16 measurement: per-chip traffic divided, analytic ICI
       all-reduce cost added; marked "derived": true.
@@ -69,22 +71,27 @@ def build_model(model: str) -> dict[str, dict]:
             raw, suffix, n_chips=n_chips, weight_bytes_per_param=wbytes
         ), n_chips, wbytes)
 
+    def headline_or_quarantine(raw, wbytes, dtype_tag):
+        # publish as the headline v5e-1 only when memory-feasible on one
+        # chip; otherwise quarantine under the dtype transparency name
+        # (maxBatchSize 0 must never be the headline v5e-1 profile)
+        doc = build_profile_json(raw, "v5e-1", n_chips=1,
+                                 weight_bytes_per_param=wbytes)
+        if doc["maxBatchSize"] > 0:
+            register("v5e-1", doc, 1, wbytes)
+        else:
+            doc["acc"] = f"v5e-1-{dtype_tag}"
+            register(f"v5e-1-{dtype_tag}", doc, 1, wbytes)
+
     # single-chip: prefer int8 (the denser serving config); keep the bf16
     # point either as the headline (when it actually fits one chip) or
-    # quarantined under the -bf16 transparency name (maxBatchSize 0 must
-    # never be published as the headline v5e-1 profile)
+    # quarantined under the -bf16 transparency name
     if raw_int8 is not None:
-        add("v5e-1", raw_int8, 1, 1.0)
+        headline_or_quarantine(raw_int8, 1.0, "int8")
         if raw_bf16 is not None:
             add("v5e-1-bf16", raw_bf16, 1, 2.0)
     elif raw_bf16 is not None:
-        doc = build_profile_json(raw_bf16, "v5e-1", n_chips=1,
-                                 weight_bytes_per_param=2.0)
-        if doc["maxBatchSize"] > 0:
-            register("v5e-1", doc, 1, 2.0)
-        else:
-            doc["acc"] = "v5e-1-bf16"
-            register("v5e-1-bf16", doc, 1, 2.0)
+        headline_or_quarantine(raw_bf16, 2.0, "bf16")
 
     # derived TP shapes
     if raw_bf16 is not None:
