@@ -57,6 +57,33 @@ class SliceShape:
         return links
 
 
+@dataclasses.dataclass(frozen=True)
+class GenerationSpec:
+    """Per-chip hardware constants of one TPU generation, used by the
+    cross-generation profile derivation (models/profiles.py): decode is
+    HBM-bandwidth-bound, prefill compute-bound, collectives ride ICI.
+
+    Values are public Cloud TPU specifications (cloud.google.com/tpu/docs
+    system-architecture pages): v5e 16 GiB / 819 GB/s / 197 bf16 TFLOPs;
+    v5p 95 GiB / 2765 GB/s / 459; v6e (Trillium) 32 GiB / 1640 GB/s /
+    918. `ici_bw_gbs` is one-way per-link bandwidth (the scaling-book
+    convention the TP derivation costs its ring all-reduces with)."""
+
+    name: str
+    hbm_per_chip_gb: float
+    hbm_bw_gbs: float
+    bf16_tflops: float
+    ici_bw_gbs: float
+    ici_latency_us: float = 1.0
+
+
+TPU_GENERATIONS: dict[str, GenerationSpec] = {
+    "v5e": GenerationSpec("v5e", 16.0, 819.0, 197.0, 45.0),
+    "v5p": GenerationSpec("v5p", 95.0, 2765.0, 459.0, 90.0),
+    "v6e": GenerationSpec("v6e", 32.0, 1640.0, 918.0, 90.0),
+}
+
+
 def _v5e(chips: int, topology: str) -> SliceShape:
     return SliceShape(f"v5e-{chips}", "v5e", topology, chips)
 

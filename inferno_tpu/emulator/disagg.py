@@ -114,6 +114,13 @@ class DisaggEngine:
         req = _Request(
             in_tokens=in_tokens, out_tokens=max(out_tokens, 1), arrived=time.time()
         )
+        if req.in_tokens + req.out_tokens > self.profile.kv_tokens_capacity:
+            # can never fit a decode engine even empty: reject instead of
+            # head-of-line-blocking the FIFO admission queue forever (real
+            # engines return 400/413 for over-length requests)
+            req.rejected = True
+            req.done_event.set()
+            return req
         req.arrived_emu = self._emu(req.arrived)
         with self.lock:
             self.prefill_waiting.append(req)
@@ -124,7 +131,7 @@ class DisaggEngine:
         self, in_tokens: int, out_tokens: int, timeout: float = 60.0
     ) -> RequestResult | None:
         req = self.submit(in_tokens, out_tokens)
-        if not req.done_event.wait(timeout):
+        if not req.done_event.wait(timeout) or req.rejected:
             return None
         assert req.first_token_at is not None and req.finished_at is not None
         return RequestResult(

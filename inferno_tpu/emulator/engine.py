@@ -63,6 +63,11 @@ class _Request:
     finished_emu: float = 0.0
     tokens_done: int = 0
     prefilled: bool = False
+    # a request whose KV footprint can NEVER fit the engine (in + out >
+    # capacity even on an empty engine) is rejected at submit instead of
+    # head-of-line-blocking the admission queue forever (real engines
+    # return 400/413 for over-length requests)
+    rejected: bool = False
 
 
 class EmulatedEngine:
@@ -96,6 +101,11 @@ class EmulatedEngine:
 
     def submit(self, in_tokens: int, out_tokens: int) -> _Request:
         req = _Request(in_tokens=in_tokens, out_tokens=max(out_tokens, 1), arrived=time.time())
+        if req.in_tokens + req.out_tokens > self.profile.kv_tokens_capacity:
+            # can never be admitted: reject instead of queueing forever
+            req.rejected = True
+            req.done_event.set()
+            return req
         with self.lock:
             elapsed = time.time() - self._last_tick_wall
             req.arrived_emu = self.emu_ms + elapsed * 1000.0 / max(self.time_scale, 1e-9)
@@ -106,7 +116,7 @@ class EmulatedEngine:
     def generate(self, in_tokens: int, out_tokens: int, timeout: float = 60.0) -> RequestResult | None:
         """Submit and block until completion (the /v1/chat path)."""
         req = self.submit(in_tokens, out_tokens)
-        if not req.done_event.wait(timeout):
+        if not req.done_event.wait(timeout) or req.rejected:
             return None
         assert req.first_token_at is not None and req.finished_at is not None
         return RequestResult(

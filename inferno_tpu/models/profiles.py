@@ -341,6 +341,32 @@ def derive_tensor_parallel(
     )
 
 
+def rescale_raw_cross_generation(raw: Mapping[str, Any], src, dst) -> dict:
+    """Rescale raw on-chip samples measured on generation `src` to an
+    analytic estimate for generation `dst` (both GenerationSpec).
+
+    Physics of the scaling: decode steps are HBM-bandwidth-bound (weights
+    + KV read every step), so step_ms scales with the bandwidth ratio;
+    prefill is MXU-compute-bound, so prefill_ms scales with the bf16
+    peak-FLOPs ratio; a mixed continuous-batching iteration is dominated
+    by its decode-side weight read, so it scales with bandwidth too —
+    conservative, since dst generations gain even more FLOPs than
+    bandwidth. Downstream fitting then applies dst's HBM size and ICI
+    constants, so memory max-batch and TP collectives are dst-native.
+    Cross-generation documents are marked derived with the scaling
+    factors recorded; they are estimates, not measurements."""
+    bw = src.hbm_bw_gbs / dst.hbm_bw_gbs
+    fl = src.bf16_tflops / dst.bf16_tflops
+    out = {k: v for k, v in raw.items() if k not in ("decode", "prefill", "mixed")}
+    out["decode"] = [{**s, "step_ms": s["step_ms"] * bw} for s in raw.get("decode", [])]
+    out["prefill"] = [
+        {**s, "prefill_ms": s["prefill_ms"] * fl} for s in raw.get("prefill", [])
+    ]
+    if raw.get("mixed"):
+        out["mixed"] = [{**s, "step_ms": s["step_ms"] * bw} for s in raw["mixed"]]
+    return out
+
+
 def build_profile_json(
     raw: Mapping[str, Any],
     acc: str,
@@ -348,14 +374,25 @@ def build_profile_json(
     at_tokens: int = 1280,
     hbm_per_chip_gb: float = 16.0,
     weight_bytes_per_param: float = 1.0,
+    ici_bw_gbs: float = 45.0,
+    ici_latency_us: float = 1.0,
+    cross_generation: Mapping[str, Any] | None = None,
 ) -> dict:
     """Full profile document for one (model, slice shape)."""
     dims_in = dict(raw["meta"]["dims"])
     n_layers_full = dims_in.pop("n_layers_full")
     dims_in["n_layers"] = n_layers_full
     dims = LlamaDims(**dims_in)
-    fitted, synth_meta = fit_tpu_profile(raw, n_layers_full, n_chips=n_chips)
-    derived = n_chips > 1
+
+    def fit(multiplier: float):
+        return fit_tpu_profile(
+            raw, n_layers_full, n_chips=n_chips,
+            ici_bw_gbs=ici_bw_gbs, ici_latency_us=ici_latency_us,
+            ici_cost_multiplier=multiplier,
+        )
+
+    fitted, synth_meta = fit(1.0)
+    derived = n_chips > 1 or cross_generation is not None
     max_batch = max_batch_from_memory(
         dims, hbm_per_chip_gb, at_tokens,
         weight_bytes_per_param=weight_bytes_per_param, n_chips=n_chips,
@@ -363,13 +400,13 @@ def build_profile_json(
     error_bars = None
     if derived:
         # Derivation error bars: the modeled ICI all-reduce cost is the
-        # only non-measured term, so refit with it halved (overlap /
-        # efficiency optimism) and doubled (congestion pessimism) and
-        # record the parm band. The memory-derived max batch is exact.
-        lo, _ = fit_tpu_profile(raw, n_layers_full, n_chips=n_chips,
-                                ici_cost_multiplier=0.5)
-        hi, _ = fit_tpu_profile(raw, n_layers_full, n_chips=n_chips,
-                                ici_cost_multiplier=2.0)
+        # only non-measured term of the TP derivation, so refit with it
+        # halved (overlap / efficiency optimism) and doubled (congestion
+        # pessimism) and record the parm band. The memory-derived max
+        # batch is exact. Cross-generation documents carry the additional
+        # hardware-ratio assumptions in `assumptions.cross_generation`.
+        lo, _ = fit(0.5)
+        hi, _ = fit(2.0)
         error_bars = {
             "ici_cost_multiplier_range": [0.5, 2.0],
             "alpha": [round(lo.decode.alpha, 4), round(hi.decode.alpha, 4)],
@@ -397,6 +434,8 @@ def build_profile_json(
             "weight_bytes_per_param": weight_bytes_per_param,
             "kv_dtype": "bfloat16",
             "hbm_per_chip_gb": hbm_per_chip_gb,
+            **({"cross_generation": dict(cross_generation)}
+               if cross_generation else {}),
         },
         "measurement_meta": dict(raw["meta"]),
     }

@@ -5,13 +5,24 @@ for an image with no kind/etcd/docker: an HTTP server implementing the
 exact REST dialect the controller's transports speak —
 
 * typed storage with monotonically increasing ``resourceVersion``s and
-  uids;
-* ``application/merge-patch+json`` deep-merge PATCH, ``/status`` and
-  ``/scale`` subresources;
+  uids; PUT **requires** ``metadata.resourceVersion`` (kube's
+  "must be specified for an update") and answers stale versions with the
+  409 Conflict message shape a real apiserver emits;
+* subresource isolation: ``PUT/PATCH /status`` moves ONLY status, a
+  main-resource update cannot touch status — a stale controller can
+  never smuggle a spec change through a status write;
+* patch dialect dispatched on Content-Type like kube-apiserver:
+  ``application/json-patch+json`` (RFC 6902 add/replace/remove/test,
+  failing ``test`` → 409), ``application/merge-patch+json`` /
+  ``strategic-merge-patch+json`` deep merge, mismatched body shape → 400,
+  unknown types → 415; ``GET /scale`` serves the autoscaling/v1 Scale
+  projection and scale patches address it;
 * chunked ``?watch=true`` streams (JSON lines) with per-event
-  resourceVersions, resuming from ``resourceVersion=N``, and **410 Gone**
+  resourceVersions, resuming from ``resourceVersion=N``, **410 Gone**
   once the event log has been compacted past the requested version
-  (``compact()`` forces this so the Watcher's relist path is testable);
+  (``compact()`` forces this so the Watcher's relist path is testable),
+  and ``allowWatchBookmarks=true`` periodic BOOKMARK events carrying the
+  resume rv;
 * Lease optimistic concurrency: POST → 409 on exists, PUT → 409 on
   resourceVersion mismatch — the semantics leader election races on;
 * VariantAutoscaling objects are validated against the **committed CRD
@@ -19,9 +30,15 @@ exact REST dialect the controller's transports speak —
   between the controller's objects and the published CRD fails tests the
   way a real API server would reject the write.
 
-Not implemented (not used by any transport in this repo): field selectors,
-server-side apply, strategic merge patch, authn/authz, CRD registration
-API.
+Conformance behaviors above are pinned by tests/test_apiserver.py's
+``TestConformance*`` classes (VERDICT r3 item 8); when the kind CI job
+(.github/workflows/ci.yaml ``kind-e2e``) records real-apiserver traces,
+byte-level fixtures can replace the documented-behavior assertions.
+
+Not implemented (not used by any transport in this repo): field
+selectors, server-side apply (apply-patch+yaml accepted as merge),
+strategic merge-key list semantics (no transport here patches lists),
+authn/authz, CRD registration API.
 """
 
 from __future__ import annotations
@@ -91,6 +108,89 @@ def _validate(obj, schema, path="") -> None:
     elif stype == "boolean":
         if not isinstance(obj, bool):
             raise ValidationError(f"{path}: expected boolean, got {type(obj).__name__}")
+
+
+def apply_json_patch(target: dict, ops: list) -> dict:
+    """RFC 6902 JSON patch (application/json-patch+json) — the subset a
+    kube client actually sends: add / replace / remove / test with plain
+    JSON-pointer paths. Mirrors kube-apiserver behavior: an invalid op or
+    a failing `test` raises (the server maps it to the HTTP error a real
+    apiserver returns)."""
+    out = copy.deepcopy(target)
+
+    def resolve(path: str):
+        if not path.startswith("/"):
+            raise ValidationError(f"json patch path must start with '/': {path!r}")
+        parts = [p.replace("~1", "/").replace("~0", "~") for p in path[1:].split("/")]
+        node = out
+        for p in parts[:-1]:
+            if isinstance(node, list):
+                node = node[int(p)]
+            elif isinstance(node, dict):
+                if p not in node:
+                    raise KeyError(path)
+                node = node[p]
+            else:
+                raise KeyError(path)
+        return node, parts[-1]
+
+    for op in ops:
+        if not isinstance(op, dict) or "op" not in op or "path" not in op:
+            raise ValidationError(f"malformed json patch op: {op!r}")
+        kind_, path = op["op"], op["path"]
+        if kind_ == "add":
+            node, leaf = resolve(path)
+            if isinstance(node, list):
+                if leaf == "-":
+                    node.append(op.get("value"))
+                else:
+                    node.insert(int(leaf), op.get("value"))
+            else:
+                node[leaf] = op.get("value")
+        elif kind_ == "replace":
+            node, leaf = resolve(path)
+            if isinstance(node, list):
+                node[int(leaf)] = op.get("value")
+            else:
+                if leaf not in node:
+                    raise KeyError(path)
+                node[leaf] = op.get("value")
+        elif kind_ == "remove":
+            node, leaf = resolve(path)
+            if isinstance(node, list):
+                del node[int(leaf)]
+            else:
+                del node[leaf]
+        elif kind_ == "test":
+            node, leaf = resolve(path)
+            cur = node[int(leaf)] if isinstance(node, list) else node[leaf]
+            if cur != op.get("value"):
+                raise _JsonPatchTestFailed(path)
+        else:
+            raise ValidationError(f"unsupported json patch op {kind_!r}")
+    return out
+
+
+class _JsonPatchTestFailed(Exception):
+    """A failing RFC 6902 `test` op — kube-apiserver answers 409."""
+
+
+def _scale_of(obj: dict) -> dict:
+    """The autoscaling/v1 Scale projection of a scalable object — what a
+    real apiserver serves on GET /scale and applies patches against."""
+    meta = obj.get("metadata", {})
+    return {
+        "apiVersion": "autoscaling/v1",
+        "kind": "Scale",
+        "metadata": {
+            "name": meta.get("name"),
+            "namespace": meta.get("namespace"),
+            "resourceVersion": meta.get("resourceVersion"),
+            "uid": meta.get("uid"),
+        },
+        "spec": {"replicas": int((obj.get("spec") or {}).get("replicas", 0))},
+        "status": {"replicas": int((obj.get("status") or {}).get("replicas", 0))},
+    }
 
 
 def merge_patch(target, patch):
@@ -238,6 +338,8 @@ class MiniApiServer:
                         obj = outer.store.objects.get((kind, ns, name))
                         if obj is None:
                             return self._status(404, "NotFound", f"{kind} {ns}/{name}")
+                        if sub == "scale":
+                            return self._send(200, _scale_of(obj))
                         return self._send(200, obj)
                     items = [
                         copy.deepcopy(obj)
@@ -284,17 +386,53 @@ class MiniApiServer:
                     if cur is None:
                         return self._status(404, "NotFound", f"{kind} {ns}/{name}")
                     sent_rv = (body.get("metadata") or {}).get("resourceVersion")
-                    if sent_rv is not None and str(sent_rv) != cur["metadata"]["resourceVersion"]:
+                    if sent_rv is None:
+                        # kube-apiserver REQUIRES resourceVersion on update
+                        # ("metadata.resourceVersion: Invalid value: 0x0:
+                        # must be specified for an update") — an
+                        # unconditional PUT is a fake-server-only illusion
+                        # that would hide lost-update races
+                        return self._status(
+                            422, "Invalid",
+                            "metadata.resourceVersion: must be specified "
+                            "for an update",
+                        )
+                    if str(sent_rv) != cur["metadata"]["resourceVersion"]:
                         return self._status(
                             409, "Conflict",
-                            f"resourceVersion mismatch: sent {sent_rv}, "
-                            f"have {cur['metadata']['resourceVersion']}",
+                            f"Operation cannot be fulfilled on {kind} "
+                            f"{ns}/{name}: the object has been modified; "
+                            "please apply your changes to the latest "
+                            f"version and try again (sent {sent_rv}, "
+                            f"have {cur['metadata']['resourceVersion']})",
                         )
+                    # subresource isolation, as a real apiserver with the
+                    # status subresource enabled: PUT /status takes ONLY
+                    # status from the body; PUT /scale updates replicas
+                    # through the Scale projection (client-go
+                    # ScaleInterface.Update); a main-resource PUT ignores
+                    # status changes
+                    if sub == "scale":
+                        replicas = (body.get("spec") or {}).get("replicas")
+                        if not isinstance(replicas, int) or replicas < 0:
+                            return self._status(
+                                422, "Invalid", "spec.replicas must be >= 0")
+                        merged = copy.deepcopy(cur)
+                        merged.setdefault("spec", {})["replicas"] = replicas
+                        merged.setdefault("status", {})["replicas"] = replicas
+                        merged["status"]["readyReplicas"] = replicas
+                    elif sub == "status":
+                        merged = copy.deepcopy(cur)
+                        merged["status"] = copy.deepcopy(body.get("status", {}))
+                    else:
+                        merged = copy.deepcopy(body)
+                        if "status" in cur or "status" in merged:
+                            merged["status"] = copy.deepcopy(cur.get("status", {}))
                     try:
-                        outer.validate(kind, body)
+                        outer.validate(kind, merged)
                     except ValidationError as e:
                         return self._status(422, "Invalid", str(e))
-                    stored = outer._stamp(kind, ns, name, body, uid=cur["metadata"]["uid"])
+                    stored = outer._stamp(kind, ns, name, merged, uid=cur["metadata"]["uid"])
                     outer.store.objects[(kind, ns, name)] = stored
                     outer.store.record(kind, "MODIFIED", stored)
                     return self._send(200, stored)
@@ -304,27 +442,84 @@ class MiniApiServer:
                 if route is None:
                     return self._status(404, "NotFound", self.path)
                 kind, ns, name, sub, _ = route
-                body = self._read_body() or {}
+                # kube-apiserver dispatches patch SEMANTICS on the declared
+                # Content-Type; an undeclared or unsupported one is 415,
+                # and a body whose JSON shape contradicts the declared
+                # type (e.g. a RFC-6902 op list sent as merge-patch) is
+                # 400 — a fake that silently merge-patched everything
+                # would accept requests a real apiserver rejects.
+                ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+                known = {
+                    "application/merge-patch+json",
+                    "application/strategic-merge-patch+json",
+                    "application/json-patch+json",
+                    "application/apply-patch+yaml",
+                }
+                if ctype and ctype not in known:
+                    return self._status(415, "UnsupportedMediaType", ctype)
+                is_json_patch = ctype == "application/json-patch+json"
+                body = self._read_body()
+                if body is None:
+                    body = [] if is_json_patch else {}
+                if is_json_patch and not isinstance(body, list):
+                    return self._status(
+                        400, "BadRequest",
+                        "json patch must be an array of operations")
+                if not is_json_patch and not isinstance(body, dict):
+                    return self._status(
+                        400, "BadRequest",
+                        f"cannot unmarshal array into object ({ctype or 'merge patch'})")
                 with outer.store.lock:
                     cur = outer.store.objects.get((kind, ns, name))
                     if cur is None:
                         return self._status(404, "NotFound", f"{kind} {ns}/{name}")
-                    if sub == "scale":
-                        replicas = ((body.get("spec") or {}).get("replicas"))
-                        if not isinstance(replicas, int) or replicas < 0:
-                            return self._status(422, "Invalid", "spec.replicas must be >= 0")
-                        merged = copy.deepcopy(cur)
-                        merged.setdefault("spec", {})["replicas"] = replicas
-                        merged.setdefault("status", {})["replicas"] = replicas
-                        merged["status"]["readyReplicas"] = replicas
-                    elif sub == "status":
-                        merged = copy.deepcopy(cur)
-                        merged["status"] = merge_patch(cur.get("status", {}), body.get("status", {}))
-                    else:
-                        merged = merge_patch(cur, body)
-                        # a plain merge patch cannot move/rename the object
-                        merged.setdefault("metadata", {})["name"] = name
-                        merged["metadata"]["namespace"] = ns
+                    try:
+                        if sub == "scale":
+                            # the Scale subresource: patches address the
+                            # autoscaling/v1 Scale object, whose only
+                            # mutable field is spec.replicas
+                            scale = _scale_of(cur)
+                            if is_json_patch:
+                                scale = apply_json_patch(scale, body)
+                            else:
+                                scale = merge_patch(scale, body)
+                            replicas = (scale.get("spec") or {}).get("replicas")
+                            if not isinstance(replicas, int) or replicas < 0:
+                                return self._status(
+                                    422, "Invalid", "spec.replicas must be >= 0")
+                            merged = copy.deepcopy(cur)
+                            merged.setdefault("spec", {})["replicas"] = replicas
+                            merged.setdefault("status", {})["replicas"] = replicas
+                            merged["status"]["readyReplicas"] = replicas
+                        elif sub == "status":
+                            merged = copy.deepcopy(cur)
+                            if is_json_patch:
+                                merged = apply_json_patch(merged, body)
+                                # subresource isolation: only status moves
+                                merged = {**copy.deepcopy(cur),
+                                          "status": merged.get("status", {})}
+                            else:
+                                merged["status"] = merge_patch(
+                                    cur.get("status", {}), body.get("status", {}))
+                        else:
+                            if is_json_patch:
+                                merged = apply_json_patch(cur, body)
+                            else:
+                                merged = merge_patch(cur, body)
+                            # a patch cannot move/rename the object
+                            merged.setdefault("metadata", {})["name"] = name
+                            merged["metadata"]["namespace"] = ns
+                            # subresource isolation holds for PATCH too: a
+                            # main-resource patch cannot touch status (a
+                            # real apiserver with the status subresource
+                            # drops such changes silently)
+                            if "status" in cur or "status" in merged:
+                                merged["status"] = copy.deepcopy(cur.get("status", {}))
+                    except _JsonPatchTestFailed as e:
+                        return self._status(409, "Conflict", f"test failed: {e}")
+                    except (KeyError, IndexError, ValueError, ValidationError) as e:
+                        return self._status(
+                            422, "Invalid", f"the provided patch is invalid: {e}")
                     try:
                         outer.validate(kind, merged)
                     except ValidationError as e:
@@ -398,6 +593,12 @@ class MiniApiServer:
             since = 0
         timeout_s = float(query.get("timeoutSeconds", ["30"])[0])
         deadline = time.time() + min(timeout_s, 300.0)
+        # kube-apiserver sends periodic BOOKMARK events (an object carrying
+        # only metadata.resourceVersion) when the client opts in — clients
+        # use them to advance their resume point across quiet periods so a
+        # later reconnect does not land below the compaction floor
+        bookmarks = query.get("allowWatchBookmarks", ["false"])[0] == "true"
+        next_bookmark = time.time() + 1.0
 
         with self.store.lock:
             floor = self.store.compaction_floor.get(kind, 0)
@@ -456,6 +657,18 @@ class MiniApiServer:
                 ]
                 if not pending:
                     self.store.lock.wait(timeout=0.1)
+                    if bookmarks and time.time() >= next_bookmark:
+                        next_bookmark = time.time() + 1.0
+                        bm = {
+                            "type": "BOOKMARK",
+                            "object": {
+                                "kind": kind,
+                                "apiVersion": _API_VERSIONS[kind],
+                                "metadata": {"resourceVersion": str(last)},
+                            },
+                        }
+                        if not send_line(bm):
+                            return
                     continue
             ok = True
             for rv, etype, obj in pending:

@@ -302,7 +302,9 @@ def test_e2e_p95_ttft_meets_raw_slo_under_poisson_load():
         gen.start()
         gen.join(30)
         time.sleep(0.5)
-        ttfts = sorted(r.ttft_ms for _, r in engine.completions)
+        # virtual-clock TTFTs: wall ones pick up host scheduling noise
+        # that has nothing to do with the queueing semantics under test
+        ttfts = sorted(r.ttft_emu_ms for _, r in engine.completions)
         assert len(ttfts) >= 30  # enough mass for a percentile
         p95 = ttfts[min(int(len(ttfts) * SLO_PERCENTILE), len(ttfts) - 1)]
         assert p95 <= slo_ttft * 1.05  # percentile meets the raw SLO

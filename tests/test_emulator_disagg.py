@@ -202,3 +202,33 @@ def test_closed_loop_matches_tandem_analyzer():
         mean_ttft, model_ttft)
     assert pred.avg_token_time * 0.7 <= mean_itl <= pred.avg_token_time * 1.45, (
         mean_itl, pred.avg_token_time)
+
+
+def test_oversized_request_rejected_not_deadlocking():
+    """A request whose KV footprint can never fit (in+out > capacity) is
+    rejected at submit; traffic queued behind it still completes instead
+    of starving behind the FIFO head (review r4)."""
+    p = DisaggProfile(alpha=20.0, beta=0.4, gamma=5.0, delta=0.001,
+                      kv_transfer_ms=0.0, kv_tokens_capacity=1_000)
+
+    def body(eng):
+        assert eng.generate(900, 200, timeout=5) is None  # rejected fast
+        ok = eng.generate(100, 8, timeout=30)  # unaffected by the reject
+        assert ok is not None
+        return ok
+
+    run_engine(p, body, time_scale=0.02)
+
+
+def test_oversized_request_rejected_aggregated_engine():
+    from inferno_tpu.emulator.engine import EmulatedEngine, EngineProfile
+
+    eng = EmulatedEngine(EngineProfile(alpha=20.0, beta=0.4, gamma=5.0,
+                                       delta=0.001, kv_tokens_capacity=1_000),
+                         time_scale=0.02)
+    eng.start()
+    try:
+        assert eng.generate(900, 200, timeout=5) is None
+        assert eng.generate(100, 8, timeout=30) is not None
+    finally:
+        eng.stop()

@@ -261,12 +261,14 @@ def test_derived_profiles_respect_hbm_roofline():
     """VERDICT r3 missing #1: the TP derivation must stay on the feasible
     side of the HBM roofline AND must not claim more per-chip efficiency
     than the single-chip measurement (the added ICI term can only slow a
-    chip down). Pins docs/design/profiling-methodology.md section
-    'Validating the derived multi-chip profiles'."""
-    V5E_HBM_GBS = 819.0
+    chip down; the cross-generation rescale preserves the measured
+    utilization by construction). Pins docs/design/profiling-methodology.md
+    section 'Validating the derived multi-chip profiles'."""
+    from inferno_tpu.config.tpu_catalog import TPU_GENERATIONS
+
     for model in ("llama-3.1-8b", "llama-3.2-3b"):
         docs = {}
-        for p in sorted(PROFILES_DIR.glob(f"{model}_v5e-*.json")):
+        for p in sorted(PROFILES_DIR.glob(f"{model}_v*.json")):
             doc = json.loads(p.read_text())
             if doc["maxBatchSize"] <= 0:
                 continue  # memory-infeasible transparency profiles
@@ -280,15 +282,20 @@ def test_derived_profiles_respect_hbm_roofline():
             dims = LlamaDims(**d, n_layers=n_layers)
             wbytes = doc["assumptions"]["weight_bytes_per_param"]
             n_chips = doc["assumptions"]["n_chips"]
+            gen = acc.split("-")[0]
+            bw = TPU_GENERATIONS[gen].hbm_bw_gbs
             params = (dims.n_layers * dims.layer_params_bytes(dtype_bytes=1)
                       + 2 * dims.hidden * dims.vocab)
             per_chip_gb = params * wbytes / 2**30 / n_chips
             alpha = doc["decodeParms"]["alpha"]
-            util = (per_chip_gb / (alpha * 1e-3)) / V5E_HBM_GBS
-            # physically feasible, and a real kernel: >20% of peak
+            util = (per_chip_gb / (alpha * 1e-3)) / bw
+            # physically feasible against the GENERATION's own peak, and
+            # a real kernel: >20% of it
             assert 0.2 < util < 1.0, (acc, util)
             dims_by[acc] = (n_chips, wbytes, util)
         # derived shapes must not beat the measured single-chip efficiency
+        # (utilization is bandwidth-relative, so cross-generation shapes
+        # compare on the same scale)
         for acc, (n_chips, wbytes, util) in dims_by.items():
             if n_chips == 1:
                 continue
@@ -302,7 +309,7 @@ def test_derived_profiles_carry_error_bars():
     """Derived profiles record the ICI-model parm band; measured ones
     don't. The base parms must sit inside their own band."""
     seen_derived = 0
-    for p in sorted(PROFILES_DIR.glob("*_v5e-*.json")):
+    for p in sorted(PROFILES_DIR.glob("*_v*.json")):
         doc = json.loads(p.read_text())
         if not doc["derived"]:
             assert "derivationErrorBars" not in doc
@@ -315,4 +322,4 @@ def test_derived_profiles_carry_error_bars():
             lo, hi = bars[key]
             base = doc[parms][key]
             assert lo <= base <= hi, (p.name, key, lo, base, hi)
-    assert seen_derived >= 4
+    assert seen_derived >= 10

@@ -26,14 +26,22 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from inferno_tpu.config.tpu_catalog import TPU_GENERATIONS
 from inferno_tpu.models.profiles import (
     PROFILES_DIR,
     UnfittableRawError,
     attach_context_buckets,
     build_profile_json,
+    rescale_raw_cross_generation,
 )
 
 RAW_DIR = PROFILES_DIR / "raw"
+
+# Cross-generation shapes derived from the v5e measurement by hardware
+# ratios (HBM bandwidth for decode, bf16 FLOPs for prefill — see
+# rescale_raw_cross_generation): the heterogeneous-pool economics of
+# BASELINE config #4 need v5p/v6e profiles that are not invented numbers.
+CROSS_GEN_SHAPES = [("v5p", 8), ("v6e", 4), ("v6e", 8)]
 
 
 def context_raws(model: str, dtype_suffix: str) -> list[tuple[int, dict]]:
@@ -100,6 +108,38 @@ def build_model(model: str) -> dict[str, dict]:
     if raw_int8 is not None:
         add("v5e-4-int8", raw_int8, 4, 1.0)
         add("v5e-8-int8", raw_int8, 8, 1.0)
+
+    # cross-generation shapes: rescale the v5e raw by hardware ratios,
+    # then run the SAME fit/TP pipeline with the generation's HBM size
+    # and ICI constants. No context buckets (the ctx sweeps are
+    # v5e-measured; cross-generation bucket estimates would stack two
+    # derivations).
+    src = TPU_GENERATIONS["v5e"]
+    for gen_name, chips in CROSS_GEN_SHAPES:
+        dst = TPU_GENERATIONS[gen_name]
+        meta = {
+            "source_generation": src.name,
+            "target_generation": dst.name,
+            "hbm_bw_scale": round(dst.hbm_bw_gbs / src.hbm_bw_gbs, 3),
+            "bf16_tflops_scale": round(dst.bf16_tflops / src.bf16_tflops, 3),
+        }
+        for raw, wbytes, suffix in (
+            (raw_bf16, 2.0, ""),
+            (raw_int8, 1.0, "-int8"),
+        ):
+            if raw is None:
+                continue
+            doc = build_profile_json(
+                rescale_raw_cross_generation(raw, src, dst),
+                f"{gen_name}-{chips}{suffix}",
+                n_chips=chips,
+                hbm_per_chip_gb=dst.hbm_per_chip_gb,
+                weight_bytes_per_param=wbytes,
+                ici_bw_gbs=dst.ici_bw_gbs,
+                ici_latency_us=dst.ici_latency_us,
+                cross_generation=meta,
+            )
+            outputs[f"{model}_{gen_name}-{chips}{suffix}.json"] = doc
     return outputs
 
 
