@@ -130,9 +130,20 @@ class DisaggEngine:
     def generate(
         self, in_tokens: int, out_tokens: int, timeout: float = 60.0
     ) -> RequestResult | None:
+        result, _ = self.generate_or_reject(in_tokens, out_tokens, timeout)
+        return result
+
+    def generate_or_reject(
+        self, in_tokens: int, out_tokens: int, timeout: float = 60.0
+    ) -> tuple[RequestResult | None, bool]:
+        """(result, rejected) — same contract as
+        EmulatedEngine.generate_or_reject: rejection (over-length, HTTP
+        400/413) must not be conflated with timeout/overload (503)."""
         req = self.submit(in_tokens, out_tokens)
-        if not req.done_event.wait(timeout) or req.rejected:
-            return None
+        if req.rejected:
+            return None, True
+        if not req.done_event.wait(timeout):
+            return None, False
         assert req.first_token_at is not None and req.finished_at is not None
         return RequestResult(
             ttft_ms=(req.first_token_at - req.arrived) * 1000.0,
@@ -141,7 +152,7 @@ class DisaggEngine:
             out_tokens=req.out_tokens,
             ttft_emu_ms=req.first_token_emu - req.arrived_emu,
             latency_emu_ms=req.finished_emu - req.arrived_emu,
-        )
+        ), False
 
     @property
     def num_running(self) -> int:

@@ -115,9 +115,22 @@ class EmulatedEngine:
 
     def generate(self, in_tokens: int, out_tokens: int, timeout: float = 60.0) -> RequestResult | None:
         """Submit and block until completion (the /v1/chat path)."""
+        result, _ = self.generate_or_reject(in_tokens, out_tokens, timeout)
+        return result
+
+    def generate_or_reject(
+        self, in_tokens: int, out_tokens: int, timeout: float = 60.0
+    ) -> tuple[RequestResult | None, bool]:
+        """(result, rejected): rejected=True means the request can NEVER
+        be served (over-length — HTTP 400/413 territory), while
+        (None, False) is a timeout/overload (503, retryable). The HTTP
+        front must not conflate them: a retry-on-503 client would retry
+        an unservable request forever."""
         req = self.submit(in_tokens, out_tokens)
-        if not req.done_event.wait(timeout) or req.rejected:
-            return None
+        if req.rejected:
+            return None, True
+        if not req.done_event.wait(timeout):
+            return None, False
         assert req.first_token_at is not None and req.finished_at is not None
         return RequestResult(
             ttft_ms=(req.first_token_at - req.arrived) * 1000.0,
@@ -126,7 +139,7 @@ class EmulatedEngine:
             out_tokens=req.out_tokens,
             ttft_emu_ms=req.first_token_emu - req.arrived_emu,
             latency_emu_ms=req.finished_emu - req.arrived_emu,
-        )
+        ), False
 
     @property
     def num_running(self) -> int:

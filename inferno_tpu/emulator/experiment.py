@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Any
 
+from inferno_tpu.emulator.disagg import DisaggEngine, DisaggProfile
 from inferno_tpu.emulator.engine import EmulatedEngine, EngineProfile
 from inferno_tpu.emulator.loadgen import LoadGenerator, RateSpec
 
@@ -44,6 +45,10 @@ class Scenario:
     time_scale: float = 0.01  # 100x faster than real time
     runs: int = 1
     seed: int = 0
+    # set for a disaggregated (prefill/decode-separated) replica unit:
+    # the engine becomes a DisaggEngine and the model prediction the
+    # tandem analyzer — `profile` is then ignored
+    disagg: DisaggProfile | None = None
 
 
 @dataclasses.dataclass
@@ -93,16 +98,35 @@ def _model_prediction(scenario: Scenario, per_replica_rps: float) -> dict[str, A
         PrefillParms,
     )
 
-    p = scenario.profile
-    analyzer = build_analyzer(
-        max_batch=p.max_batch,
-        max_queue=p.max_batch * MAX_QUEUE_TO_BATCH_RATIO,
-        decode=DecodeParms(alpha=p.alpha, beta=p.beta),
-        prefill=PrefillParms(gamma=p.gamma, delta=p.delta),
-        request=RequestSize(
-            avg_in_tokens=scenario.in_tokens, avg_out_tokens=scenario.out_tokens
-        ),
+    request = RequestSize(
+        avg_in_tokens=scenario.in_tokens, avg_out_tokens=scenario.out_tokens
     )
+    if scenario.disagg is not None:
+        from inferno_tpu.analyzer import build_disagg_analyzer
+        from inferno_tpu.config.types import DisaggSpec
+
+        d = scenario.disagg
+        analyzer = build_disagg_analyzer(
+            max_batch=d.decode_max_batch,
+            max_queue=d.decode_max_batch * MAX_QUEUE_TO_BATCH_RATIO,
+            decode=DecodeParms(alpha=d.alpha, beta=d.beta),
+            # the tandem model folds the KV handoff into the prefill
+            # constant (analyzer/disagg.py docstring)
+            prefill=PrefillParms(gamma=d.gamma + d.kv_transfer_ms, delta=d.delta),
+            request=request,
+            spec=DisaggSpec(prefill_slices=d.prefill_engines,
+                            decode_slices=d.decode_engines,
+                            prefill_max_batch=d.prefill_max_batch),
+        )
+    else:
+        p = scenario.profile
+        analyzer = build_analyzer(
+            max_batch=p.max_batch,
+            max_queue=p.max_batch * MAX_QUEUE_TO_BATCH_RATIO,
+            decode=DecodeParms(alpha=p.alpha, beta=p.beta),
+            prefill=PrefillParms(gamma=p.gamma, delta=p.delta),
+            request=request,
+        )
     try:
         m = analyzer.analyze(per_replica_rps)
     except Exception as exc:  # over the stability limit etc.
@@ -122,7 +146,9 @@ def run_scenario(scenario: Scenario) -> dict[str, Any]:
     for run_idx in range(scenario.runs):
         stats = RunStats()
         engines = [
-            EmulatedEngine(scenario.profile, time_scale=scenario.time_scale)
+            DisaggEngine(scenario.disagg, time_scale=scenario.time_scale)
+            if scenario.disagg is not None
+            else EmulatedEngine(scenario.profile, time_scale=scenario.time_scale)
             for _ in range(scenario.replicas)
         ]
         for e in engines:
@@ -232,6 +258,18 @@ DEFAULT_SCENARIOS = (
         name="ramp",
         rate=RateSpec(((2.0, 5.0), (2.0, 15.0), (2.0, 30.0))),
         replicas=2,
+    ),
+    Scenario(
+        name="disagg-steady",
+        rate=RateSpec(((4.0, 8.0),)),
+        disagg=DisaggProfile(alpha=20.0, beta=0.4, gamma=5.0, delta=0.02,
+                             prefill_max_batch=8, decode_max_batch=64,
+                             prefill_engines=1, decode_engines=2,
+                             kv_transfer_ms=2.0),
+        # coarser compression than the aggregated scenarios: the disagg
+        # emulator's virtual clock derives from scaled wall time, so
+        # admission-poll overhead shrinks with a larger scale
+        time_scale=0.05,
     ),
 )
 

@@ -72,7 +72,15 @@ class EmulatorServer:
                 prompt = " ".join(str(m.get("content", "")) for m in messages)
                 in_tokens = max(1, len(prompt.split()))
                 out_tokens = int(payload.get("max_tokens", 64) or 64)
-                result = outer.engine.generate(in_tokens, out_tokens)
+                result, rejected = outer.engine.generate_or_reject(
+                    in_tokens, out_tokens
+                )
+                if rejected:
+                    # over-length for the engine's KV budget: permanent,
+                    # like a real engine's 400 — NOT a retryable 503
+                    self.send_response(400)
+                    self.end_headers()
+                    return
                 if result is None:
                     self.send_response(503)
                     self.end_headers()

@@ -348,13 +348,16 @@ def rescale_raw_cross_generation(raw: Mapping[str, Any], src, dst) -> dict:
     Physics of the scaling: decode steps are HBM-bandwidth-bound (weights
     + KV read every step), so step_ms scales with the bandwidth ratio;
     prefill is MXU-compute-bound, so prefill_ms scales with the bf16
-    peak-FLOPs ratio; a mixed continuous-batching iteration is dominated
-    by its decode-side weight read, so it scales with bandwidth too —
-    conservative, since dst generations gain even more FLOPs than
-    bandwidth. Downstream fitting then applies dst's HBM size and ICI
-    constants, so memory max-batch and TP collectives are dst-native.
-    Cross-generation documents are marked derived with the scaling
-    factors recorded; they are estimates, not measurements."""
+    peak-FLOPs ratio. A mixed continuous-batching iteration carries BOTH
+    components, so it scales by whichever hardware gain is SMALLER
+    (max of the two src/dst ratios): assuming the bigger gain for the
+    whole iteration would credit the part of the work the slower-improving
+    unit bounds — e.g. v5p gains 3.4x bandwidth but only 2.3x FLOPs, so
+    its mixed steps improve at most 2.3x. Downstream fitting then applies
+    dst's HBM size and ICI constants, so memory max-batch and TP
+    collectives are dst-native. Cross-generation documents are marked
+    derived with the scaling factors recorded; they are estimates, not
+    measurements."""
     bw = src.hbm_bw_gbs / dst.hbm_bw_gbs
     fl = src.bf16_tflops / dst.bf16_tflops
     out = {k: v for k, v in raw.items() if k not in ("decode", "prefill", "mixed")}
@@ -363,7 +366,10 @@ def rescale_raw_cross_generation(raw: Mapping[str, Any], src, dst) -> dict:
         {**s, "prefill_ms": s["prefill_ms"] * fl} for s in raw.get("prefill", [])
     ]
     if raw.get("mixed"):
-        out["mixed"] = [{**s, "step_ms": s["step_ms"] * bw} for s in raw["mixed"]]
+        mixed_scale = max(bw, fl)  # conservative: the smaller improvement
+        out["mixed"] = [
+            {**s, "step_ms": s["step_ms"] * mixed_scale} for s in raw["mixed"]
+        ]
     return out
 
 

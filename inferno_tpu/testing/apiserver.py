@@ -175,6 +175,20 @@ class _JsonPatchTestFailed(Exception):
     """A failing RFC 6902 `test` op — kube-apiserver answers 409."""
 
 
+def _apply_scale(cur: dict, replicas) -> dict | None:
+    """Validated scale application shared by PUT /scale and PATCH /scale
+    (their semantics must never diverge): None when replicas is invalid,
+    else the updated object (readyReplicas follows instantly — this fake
+    has no kubelet to converge it)."""
+    if not isinstance(replicas, int) or replicas < 0:
+        return None
+    merged = copy.deepcopy(cur)
+    merged.setdefault("spec", {})["replicas"] = replicas
+    merged.setdefault("status", {})["replicas"] = replicas
+    merged["status"]["readyReplicas"] = replicas
+    return merged
+
+
 def _scale_of(obj: dict) -> dict:
     """The autoscaling/v1 Scale projection of a scalable object — what a
     real apiserver serves on GET /scale and applies patches against."""
@@ -413,14 +427,10 @@ class MiniApiServer:
                     # ScaleInterface.Update); a main-resource PUT ignores
                     # status changes
                     if sub == "scale":
-                        replicas = (body.get("spec") or {}).get("replicas")
-                        if not isinstance(replicas, int) or replicas < 0:
+                        merged = _apply_scale(cur, (body.get("spec") or {}).get("replicas"))
+                        if merged is None:
                             return self._status(
                                 422, "Invalid", "spec.replicas must be >= 0")
-                        merged = copy.deepcopy(cur)
-                        merged.setdefault("spec", {})["replicas"] = replicas
-                        merged.setdefault("status", {})["replicas"] = replicas
-                        merged["status"]["readyReplicas"] = replicas
                     elif sub == "status":
                         merged = copy.deepcopy(cur)
                         merged["status"] = copy.deepcopy(body.get("status", {}))
@@ -483,14 +493,11 @@ class MiniApiServer:
                                 scale = apply_json_patch(scale, body)
                             else:
                                 scale = merge_patch(scale, body)
-                            replicas = (scale.get("spec") or {}).get("replicas")
-                            if not isinstance(replicas, int) or replicas < 0:
+                            merged = _apply_scale(
+                                cur, (scale.get("spec") or {}).get("replicas"))
+                            if merged is None:
                                 return self._status(
                                     422, "Invalid", "spec.replicas must be >= 0")
-                            merged = copy.deepcopy(cur)
-                            merged.setdefault("spec", {})["replicas"] = replicas
-                            merged.setdefault("status", {})["replicas"] = replicas
-                            merged["status"]["readyReplicas"] = replicas
                         elif sub == "status":
                             merged = copy.deepcopy(cur)
                             if is_json_patch:
@@ -657,19 +664,24 @@ class MiniApiServer:
                 ]
                 if not pending:
                     self.store.lock.wait(timeout=0.1)
-                    if bookmarks and time.time() >= next_bookmark:
-                        next_bookmark = time.time() + 1.0
-                        bm = {
-                            "type": "BOOKMARK",
-                            "object": {
-                                "kind": kind,
-                                "apiVersion": _API_VERSIONS[kind],
-                                "metadata": {"resourceVersion": str(last)},
-                            },
-                        }
-                        if not send_line(bm):
-                            return
-                    continue
+                    send_bookmark = bookmarks and time.time() >= next_bookmark
+            if not pending:
+                # socket writes happen OUTSIDE the store lock (like the
+                # pending-event loop below): a slow watch client must
+                # never block every other request handler on the lock
+                if send_bookmark:
+                    next_bookmark = time.time() + 1.0
+                    bm = {
+                        "type": "BOOKMARK",
+                        "object": {
+                            "kind": kind,
+                            "apiVersion": _API_VERSIONS[kind],
+                            "metadata": {"resourceVersion": str(last)},
+                        },
+                    }
+                    if not send_line(bm):
+                        return
+                continue
             ok = True
             for rv, etype, obj in pending:
                 last = max(last, rv)

@@ -1,0 +1,79 @@
+"""Bench contract tests: the north-star structure the driver and judge
+read must hold — headline anchored to v5e, cross-generation rows present
+but never the headline, the ICI sensitivity well-formed and monotone, and
+the whole document strict-JSON (docs-contract style: the JSON is the
+deliverable, so its shape is pinned here rather than discovered broken in
+a bench run)."""
+
+import json
+import math
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench
+
+
+@pytest.fixture(scope="module")
+def ns():
+    return bench.north_star()
+
+
+def test_headline_rests_on_v5e(ns):
+    assert ns["chosen_shape"].startswith("v5e")
+    assert ns["vs_baseline"] > 1.0  # the thesis number
+    # cross-generation rows are REPORTED (BASELINE config #4)...
+    table = ns["per_shape_usd_per_mtok"]
+    assert any(a.startswith("v6e") for a in table)
+    assert any(a.startswith("v5p") for a in table)
+    # ...and the headline is the cheapest v5e, not the global min
+    v5e_min = min(v for a, v in table.items() if a.startswith("v5e"))
+    assert ns["tpu"]["usd_per_mtok"] == pytest.approx(v5e_min, rel=1e-3)
+
+
+def test_ici_sensitivity_monotone_with_finite_break_even(ns):
+    s = ns["sensitivity"]["ici_efficiency"]
+    rows = s["usd_per_mtok_at_multiplier"]
+    vals = [rows[k] for k in ("0.0", "0.5", "1.0", "2.0", "4.0", "8.0")]
+    assert all(v is not None for v in vals)
+    # more ICI cost can only make the shape more expensive
+    assert vals == sorted(vals)
+    be = s["break_even_multiplier"]
+    # the committed profiles break even at a finite multiplier > 1 (the
+    # headline survives the base model but not arbitrary error)
+    assert isinstance(be, float) and be > 1.0
+    # consistency: the row just below break-even still beats the A100
+    a100 = ns["a100"]["usd_per_mtok"]
+    assert rows["1.0"] < a100 < rows["8.0"]
+
+
+def test_caveats_first_class(ns):
+    s = ns["sensitivity"]
+    assert "batch_asymmetry" in s["caveats"] and "int8_quality" in s["caveats"]
+    # the TPU side re-sized at the A100's measured batch-64 cap costs more
+    # than the headline (that is the point of reporting it)
+    assert s["tpu_capped_at_batch64_usd_per_mtok"] > ns["tpu"]["usd_per_mtok"]
+
+
+def test_north_star_is_strict_json(ns):
+    # the bench output contract: one RFC-8259 line; Infinity/NaN would
+    # break jq / Go / JSON.parse consumers (review r4)
+    text = json.dumps(ns, allow_nan=False)
+    assert "Infinity" not in text and "NaN" not in text
+
+
+def test_ici_sensitivity_none_for_measured_shape():
+    a100 = 0.16
+    assert bench.ici_sensitivity("v5e-1", a100) is None  # pure measurement
+
+
+def test_replica_arithmetic_matches_reference_formula(ns):
+    """replicas = ceil(rate / lambda*) (allocation.go:133-141) on the
+    headline shape."""
+    tpu = ns["tpu"]
+    assert tpu["replicas"] == max(
+        1, math.ceil(bench.ARRIVAL_RPS / tpu["rate_per_replica"])
+    )

@@ -121,3 +121,45 @@ def test_two_variants_sharing_model_id_keep_their_own_profiles():
     # the bucketed profile's 16k-context parms are slower than the fast
     # profile's: the variants MUST diverge despite the shared modelID
     assert bucketed.num_replicas > fast.num_replicas >= 1, (bucketed, fast)
+
+
+def test_bucket_resolution_rebases_at_tokens():
+    """The K-rescale (batch = max_batch * at_tokens / K) assumes at_tokens
+    is the context the cap was computed at; a resolved bucket must carry
+    its OWN sizing token count, falling back to max_in_tokens when the
+    wire omits atTokens (review r4 — the base at_tokens would inflate a
+    long-context cap ~at_tokens-fold)."""
+    prof = AcceleratorProfile(
+        acc="v5e-4", acc_count=1, max_batch_size=64, at_tokens=1280,
+        decode_parms=DecodeParms(16.0, 0.2),
+        prefill_parms=PrefillParms(8.0, 0.001),
+        context_buckets=[
+            ContextBucket(max_in_tokens=8192, max_batch_size=12,
+                          at_tokens=8448,  # the builder's max_in + 256
+                          decode_parms=DecodeParms(20.0, 0.3),
+                          prefill_parms=PrefillParms(8.0, 0.001)),
+            ContextBucket(max_in_tokens=32768, max_batch_size=4,
+                          decode_parms=DecodeParms(26.0, 0.5),
+                          prefill_parms=PrefillParms(8.0, 0.001)),
+        ],
+    )
+    spec = prof.to_perf_spec("m", avg_in_tokens=6000)
+    assert spec.max_batch_size == 12 and spec.at_tokens == 8448
+    spec = prof.to_perf_spec("m", avg_in_tokens=20000)
+    assert spec.max_batch_size == 4
+    assert spec.at_tokens == 32768  # atTokens absent: max_in_tokens fallback
+    base = prof.to_perf_spec("m", avg_in_tokens=0)
+    assert base.max_batch_size == 64 and base.at_tokens == 1280
+    # a bucket that only refines parms (no batch override) keeps the base
+    # batch AND the base at_tokens — the base cap's KV budget still applies
+    parms_only = AcceleratorProfile(
+        acc="v5e-4", max_batch_size=64, at_tokens=1280,
+        decode_parms=DecodeParms(16.0, 0.2),
+        prefill_parms=PrefillParms(8.0, 0.001),
+        context_buckets=[ContextBucket(max_in_tokens=4096,
+                                       decode_parms=DecodeParms(18.0, 0.25),
+                                       prefill_parms=PrefillParms(8.0, 0.001))],
+    )
+    spec = parms_only.to_perf_spec("m", avg_in_tokens=2000)
+    assert spec.max_batch_size == 64 and spec.at_tokens == 1280
+    assert spec.decode_parms.alpha == 18.0

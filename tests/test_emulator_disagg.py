@@ -232,3 +232,46 @@ def test_oversized_request_rejected_aggregated_engine():
         assert eng.generate(100, 8, timeout=30) is not None
     finally:
         eng.stop()
+
+
+def test_http_rejects_overlength_with_400_not_503():
+    """An unservable (over-length) request is a permanent 400, never the
+    retryable 503 a timeout maps to (review r4: a retry-on-503 client
+    would retry it forever)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from inferno_tpu.emulator.server import EmulatorServer
+
+    srv = EmulatorServer(
+        model_id="m",
+        engine=DisaggEngine(
+            DisaggProfile(alpha=10.0, beta=0.2, gamma=5.0, delta=0.001,
+                          kv_tokens_capacity=500),
+            time_scale=0.02,
+        ),
+    )
+    srv.start()
+    try:
+        body = json.dumps({"model": "m",
+                           "messages": [{"role": "user", "content": "x " * 400}],
+                           "max_tokens": 400}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        # a servable request on the same engine still succeeds
+        ok = json.dumps({"model": "m",
+                         "messages": [{"role": "user", "content": "hi"}],
+                         "max_tokens": 8}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/chat/completions", data=ok,
+            headers={"Content-Type": "application/json"})
+        assert urllib.request.urlopen(req, timeout=60).status == 200
+    finally:
+        srv.stop()
