@@ -20,12 +20,13 @@ test:
 test-unit:
 	$(PYTHON) -m pytest tests/ -x -q \
 	  --ignore=tests/test_emulator.py --ignore=tests/test_e2e_http.py \
-	  --ignore=tests/test_e2e_sharegpt.py --ignore=tests/test_apiserver.py
+	  --ignore=tests/test_e2e_sharegpt.py --ignore=tests/test_apiserver.py \
+	  --ignore=tests/test_e2e_disagg.py
 
 # e2e tier: emulator HTTP server + MiniProm + controller loop over sockets.
 test-e2e:
 	$(PYTHON) -m pytest tests/test_emulator.py tests/test_e2e_http.py \
-	  tests/test_e2e_sharegpt.py -x -q
+	  tests/test_e2e_sharegpt.py tests/test_e2e_disagg.py -x -q
 
 # API-server tier (envtest analogue): RestKubeClient/watch/leader against
 # MiniApiServer over real sockets, incl. a cycle scaling a Deployment.

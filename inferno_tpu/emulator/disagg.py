@@ -39,7 +39,7 @@ import threading
 import time
 from collections import deque
 
-from inferno_tpu.emulator.engine import RequestResult, _Request
+from inferno_tpu.emulator.engine import RequestResult, _Request, wait_for_result
 
 
 @dataclasses.dataclass(frozen=True)
@@ -136,23 +136,10 @@ class DisaggEngine:
     def generate_or_reject(
         self, in_tokens: int, out_tokens: int, timeout: float = 60.0
     ) -> tuple[RequestResult | None, bool]:
-        """(result, rejected) — same contract as
-        EmulatedEngine.generate_or_reject: rejection (over-length, HTTP
-        400/413) must not be conflated with timeout/overload (503)."""
-        req = self.submit(in_tokens, out_tokens)
-        if req.rejected:
-            return None, True
-        if not req.done_event.wait(timeout):
-            return None, False
-        assert req.first_token_at is not None and req.finished_at is not None
-        return RequestResult(
-            ttft_ms=(req.first_token_at - req.arrived) * 1000.0,
-            latency_ms=(req.finished_at - req.arrived) * 1000.0,
-            in_tokens=req.in_tokens,
-            out_tokens=req.out_tokens,
-            ttft_emu_ms=req.first_token_emu - req.arrived_emu,
-            latency_emu_ms=req.finished_emu - req.arrived_emu,
-        ), False
+        """(result, rejected) — the shared contract in
+        engine.wait_for_result: rejection (over-length, HTTP 400/413)
+        must not be conflated with timeout/overload (503)."""
+        return wait_for_result(self.submit(in_tokens, out_tokens), timeout)
 
     @property
     def num_running(self) -> int:

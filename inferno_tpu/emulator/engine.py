@@ -70,6 +70,29 @@ class _Request:
     rejected: bool = False
 
 
+def wait_for_result(
+    req: _Request, timeout: float
+) -> tuple[RequestResult | None, bool]:
+    """THE (result, rejected) contract, shared by the aggregated and
+    disaggregated engines so their rejection/timeout/result semantics
+    cannot drift: (None, True) = permanently unservable (rejected at
+    submit), (None, False) = timeout/overload, else the completed
+    RequestResult."""
+    if req.rejected:
+        return None, True
+    if not req.done_event.wait(timeout):
+        return None, False
+    assert req.first_token_at is not None and req.finished_at is not None
+    return RequestResult(
+        ttft_ms=(req.first_token_at - req.arrived) * 1000.0,
+        latency_ms=(req.finished_at - req.arrived) * 1000.0,
+        in_tokens=req.in_tokens,
+        out_tokens=req.out_tokens,
+        ttft_emu_ms=req.first_token_emu - req.arrived_emu,
+        latency_emu_ms=req.finished_emu - req.arrived_emu,
+    ), False
+
+
 class EmulatedEngine:
     """One emulated replica, running its decode loop on a thread."""
 
@@ -126,20 +149,7 @@ class EmulatedEngine:
         (None, False) is a timeout/overload (503, retryable). The HTTP
         front must not conflate them: a retry-on-503 client would retry
         an unservable request forever."""
-        req = self.submit(in_tokens, out_tokens)
-        if req.rejected:
-            return None, True
-        if not req.done_event.wait(timeout):
-            return None, False
-        assert req.first_token_at is not None and req.finished_at is not None
-        return RequestResult(
-            ttft_ms=(req.first_token_at - req.arrived) * 1000.0,
-            latency_ms=(req.finished_at - req.arrived) * 1000.0,
-            in_tokens=req.in_tokens,
-            out_tokens=req.out_tokens,
-            ttft_emu_ms=req.first_token_emu - req.arrived_emu,
-            latency_emu_ms=req.finished_emu - req.arrived_emu,
-        ), False
+        return wait_for_result(self.submit(in_tokens, out_tokens), timeout)
 
     @property
     def num_running(self) -> int:

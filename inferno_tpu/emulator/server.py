@@ -5,7 +5,10 @@ OpenAI-compatible POST /v1/chat/completions plus GET /metrics in either
 the vllm-tpu or jetstream exposition vocabulary, so a real Prometheus
 (or the collector directly) can scrape it. Configured via constructor or
 environment (MODEL_ID, DECODE_ALPHA/BETA, PREFILL_GAMMA/DELTA,
-MAX_BATCH, ENGINE).
+MAX_BATCH, ENGINE, PORT; DISAGG=true selects the prefill/decode-
+separated replica unit with PREFILL_MAX_BATCH, DISAGG_PREFILL_ENGINES,
+DISAGG_DECODE_ENGINES, KV_TRANSFER_MS). Over-length requests (KV
+footprint beyond the engine's budget) get 400; timeouts/overload 503.
 """
 
 from __future__ import annotations

@@ -96,17 +96,44 @@ Grid make_grid(const Lane& ln) {
 Stats solve_stats(double lam, const Grid& g) {
   // logp[0] = 0, logp[k] = k*log(lam) - cml[k-1]
   const double loglam = std::log(lam);
-  double m = 0.0;  // max over logp (logp[0] = 0 included)
-  for (int32_t k = 1; k <= g.K; ++k)
-    m = std::max(m, k * loglam - g.cml[k - 1]);
+  // max over logp in O(log K): logp is concave in k (its increments
+  // loglam - logmu(k) are nonincreasing because mu(n) is nondecreasing),
+  // so the argmax is the last k whose increment is still nonnegative —
+  // binary-searchable on logmu(k) = cml[k-1] - cml[k-2]. logp[0] = 0 is
+  // included via the k_peak = 0 case.
+  double m = 0.0;
+  if (g.K >= 1 && loglam >= g.cml[0]) {  // logmu(1) = cml[0]
+    int32_t lo = 1, hi = g.K;  // invariant: logmu(lo) <= loglam
+    while (lo < hi) {
+      const int32_t mid = (lo + hi + 1) / 2;
+      const double logmu = g.cml[mid - 1] - g.cml[mid - 2];
+      if (logmu <= loglam)
+        lo = mid;
+      else
+        hi = mid - 1;
+    }
+    m = std::max(lo * loglam - g.cml[lo - 1], 0.0);
+  }
 
   double z = std::exp(-m);          // state 0
   double sum_k = 0.0;               // sum k * w
   double mass_gt_b = 0.0;           // states k > B, summed directly
   double sum_k_le_b = 0.0;
   double w_cap = 0.0;               // state K
+  // logp[k] is concave in k (mu(n) is nondecreasing), so the mass sits
+  // in one contiguous window around the max; states whose normalized
+  // log-weight is below -45 contribute < 3e-20 — invisible in the f64
+  // sums — and exp() dominates this kernel's cost, so skip them. (A
+  // binary-searched window was tried and is SLOWER: the sizing bisection
+  // probes rates near saturation where the distribution is flat and the
+  // window spans most of K, so the branchy search only added overhead.)
+  // State K is always exponentiated: p_block must reflect it even when
+  // tiny.
+  constexpr double kUnderflow = -45.0;
   for (int32_t k = 1; k <= g.K; ++k) {
-    double w = std::exp(k * loglam - g.cml[k - 1] - m);
+    const double lp = k * loglam - g.cml[k - 1] - m;
+    if (lp < kUnderflow && k != g.K) continue;
+    double w = std::exp(lp);
     z += w;
     sum_k += k * w;
     if (k <= g.B)

@@ -180,7 +180,8 @@ def _apply_scale(cur: dict, replicas) -> dict | None:
     (their semantics must never diverge): None when replicas is invalid,
     else the updated object (readyReplicas follows instantly — this fake
     has no kubelet to converge it)."""
-    if not isinstance(replicas, int) or replicas < 0:
+    # bool is an int subclass: {"replicas": true} must be 422, like kube
+    if not isinstance(replicas, int) or isinstance(replicas, bool) or replicas < 0:
         return None
     merged = copy.deepcopy(cur)
     merged.setdefault("spec", {})["replicas"] = replicas
@@ -647,24 +648,32 @@ class MiniApiServer:
             if not send_line({"type": "ADDED", "object": obj}):
                 return
         while time.time() < deadline:
+            # ALL socket writes happen outside the store lock: a slow
+            # watch client must never block every other request handler
+            expired = False
             with self.store.lock:
                 floor = self.store.compaction_floor.get(kind, 0)
                 if last < floor:
-                    send_line({
-                        "type": "ERROR",
-                        "object": {"kind": "Status", "code": 410,
-                                   "reason": "Expired",
-                                   "message": f"resourceVersion {last} is too old"},
-                    })
-                    break
-                pending = [
-                    (rv, etype, obj)
-                    for rv, etype, obj in self.store.events.get(kind, [])
-                    if rv > last and (ns is None or obj["metadata"].get("namespace") == ns)
-                ]
-                if not pending:
-                    self.store.lock.wait(timeout=0.1)
-                    send_bookmark = bookmarks and time.time() >= next_bookmark
+                    expired = True
+                    pending = []
+                else:
+                    pending = [
+                        (rv, etype, obj)
+                        for rv, etype, obj in self.store.events.get(kind, [])
+                        if rv > last
+                        and (ns is None or obj["metadata"].get("namespace") == ns)
+                    ]
+                    if not pending:
+                        self.store.lock.wait(timeout=0.1)
+                        send_bookmark = bookmarks and time.time() >= next_bookmark
+            if expired:
+                send_line({
+                    "type": "ERROR",
+                    "object": {"kind": "Status", "code": 410,
+                               "reason": "Expired",
+                               "message": f"resourceVersion {last} is too old"},
+                })
+                break
             if not pending:
                 # socket writes happen OUTSIDE the store lock (like the
                 # pending-event loop below): a slow watch client must

@@ -183,3 +183,54 @@ def test_limited_mode_spills_low_priority_to_other_generation():
     # Freemium spills to the v6e pool — served, not starved
     assert f_alloc.accelerator == "v6e-4", f_alloc
     assert f_alloc.num_replicas >= 1
+
+
+def test_baseline_config4_v5e8_plus_v5p8_pool():
+    """BASELINE.json config #4 verbatim: a heterogeneous v5e-8 + v5p-8
+    pool with cost-optimal assignment. Committed profiles for BOTH shapes
+    (v5e-8 measured-derived, v5p-8 cross-generation); the cheap v5e pool
+    is capacity-limited, so the greedy solver keeps Premium on v5e-8 and
+    spills Freemium to the v5p-8 pool."""
+    prom = make_prom(arrival_rps=100.0, out_tok=128.0, in_tok=128.0)
+    cluster = InMemoryCluster()
+    cluster.set_configmap(CFG_NS, "accelerator-unit-costs", {
+        "v5e-8": json.dumps({"cost": 1.20}),
+        "v5p-8": json.dumps({"cost": 4.20}),
+    })
+    cluster.set_configmap(CFG_NS, "service-classes-config", service_classes_cm(24.0))
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {
+        "GLOBAL_OPT_INTERVAL": "30s",
+        "OPTIMIZER_MODE": "limited",
+        # one v5e-8 slice fits; everything else must use the v5p pool
+        "TPU_CAPACITY": json.dumps({"v5e": 8, "v5p": 64}),
+    })
+    for name, klass in (("llama-premium", "Premium"), ("llama-freemium", "Freemium")):
+        va = VariantAutoscaling(
+            name=name, namespace=NS, labels={ACCELERATOR_LABEL: "v5e-8"},
+            spec=VariantAutoscalingSpec(
+                model_id=MODEL,
+                slo_class_ref=ConfigMapKeyRef(name="service-classes-config", key=klass),
+                accelerators=[committed_profile("v5e-8-int8"),
+                              committed_profile("v5p-8-int8")],
+            ),
+        )
+        va.spec.accelerators[0].acc = "v5e-8"
+        va.spec.accelerators[1].acc = "v5p-8"
+        cluster.add_variant_autoscaling(va)
+        cluster.add_deployment(NS, name, replicas=1)
+
+    rec = Reconciler(
+        kube=cluster, prom=prom,
+        config=ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar",
+                                profile_correction=False, keep_accelerator=False),
+    )
+    report = rec.run_cycle()
+    assert report.errors == [], report.errors
+
+    p = cluster.get_variant_autoscaling(NS, "llama-premium").status.desired_optimized_alloc
+    f = cluster.get_variant_autoscaling(NS, "llama-freemium").status.desired_optimized_alloc
+    # Premium (priority 1) takes the whole contended cheap pool
+    assert p.accelerator == "v5e-8" and p.num_replicas == 1
+    # Freemium is served from the v5p pool, not starved
+    assert f.accelerator == "v5p-8", f
+    assert f.num_replicas >= 1

@@ -165,3 +165,34 @@ def test_crd_round_trip_random_documents(seed):
             assert parsed.disagg.decode_slices == orig["disagg"]["decodeSlices"]
         if "contextBuckets" in orig:
             assert len(parsed.context_buckets) == len(orig["contextBuckets"])
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_native_backend_parity_random_fleets(seed):
+    """The C++ solver (the compute_backend='auto' production path on
+    controller pods without a TPU attachment) against the scalar
+    definition on the same random fleets — aggregated AND tandem lanes,
+    idle servers included."""
+    from inferno_tpu import native
+
+    if not native.available():
+        pytest.skip(f"native solver unavailable: {native.load_error()}")
+    spec = random_spec(np.random.default_rng(seed), n_servers=8)
+    scalar, nat = System(spec), System(spec)
+    scalar.calculate_all()
+    calculate_fleet(nat, backend="native")
+    checked = 0
+    for name, s_server in scalar.servers.items():
+        n_server = nat.servers[name]
+        assert set(n_server.all_allocations) == set(s_server.all_allocations), name
+        for acc, s_alloc in s_server.all_allocations.items():
+            n_alloc = n_server.all_allocations[acc]
+            assert n_alloc.batch_size == s_alloc.batch_size, (name, acc)
+            assert abs(n_alloc.num_replicas - s_alloc.num_replicas) <= 1, (
+                name, acc, n_alloc.num_replicas, s_alloc.num_replicas)
+            if s_alloc.max_arrv_rate_per_replica > 0:
+                assert n_alloc.max_arrv_rate_per_replica == pytest.approx(
+                    s_alloc.max_arrv_rate_per_replica, rel=2e-2
+                ), (name, acc)
+            checked += 1
+    assert checked >= 16
