@@ -343,3 +343,48 @@ def test_build_is_atomic_and_leaves_no_temp(tmp_path):
     # the freshly renamed artifact is a loadable, complete library
     lib = ctypes.CDLL(lib_path)
     assert hasattr(lib, "inferno_fleet_size")
+
+
+def test_near_saturation_lanes_match_scalar():
+    """Adversarial operating points for the optimized stationary solve
+    (binary-searched argmax + underflow-guarded summation): lanes offered
+    load AT and just under the stability boundary, where the state
+    distribution is flat and the optimization's window spans most of the
+    chain. Decisions must still match the scalar analyzer."""
+    import math
+
+    from inferno_tpu.analyzer import RequestSize, TargetPerf, build_analyzer
+    from inferno_tpu.config.types import DecodeParms, PrefillParms
+
+    n = 8
+    alpha, beta = 12.0, 0.25
+    gamma, delta = 6.0, 0.01
+    mb = 64
+    # build_analyzer's chain is max_batch + max_queue states; the lane's
+    # occupancy_cap must equal it exactly or the reference lambda* comes
+    # from a different birth-death chain (review r4)
+    an = build_analyzer(mb, mb * 10, DecodeParms(alpha, beta),
+                        PrefillParms(gamma, delta), RequestSize(128, 64))
+    tr, _, _ = an.size(TargetPerf(target_ttft=500.0, target_itl=30.0))
+    lam = min(tr.rate_target_ttft, tr.rate_target_itl, tr.rate_target_tps)
+    # offered rates from 50% to 99.9% of n_replicas*lambda* for 3 replicas
+    fracs = [0.5, 0.9, 0.99, 0.999, 1.0, 1.5, 4.0, 16.0]
+    params = FleetParams(
+        alpha=np.full(n, alpha), beta=np.full(n, beta),
+        gamma=np.full(n, gamma), delta=np.full(n, delta),
+        in_tokens=np.full(n, 128.0), out_tokens=np.full(n, 64.0),
+        max_batch=np.full(n, mb, np.int32),
+        occupancy_cap=np.full(n, mb * 11, np.int32),
+        target_ttft=np.full(n, 500.0), target_itl=np.full(n, 30.0),
+        target_tps=np.zeros(n),
+        total_rate=np.array([3 * lam * f for f in fracs]),
+        min_replicas=np.ones(n, np.int32),
+        cost_per_replica=np.full(n, 4.8),
+    )
+    out = native.fleet_size_native(params)
+    for i, f in enumerate(fracs):
+        expect = max(1, math.ceil(3 * lam * f / lam))
+        got = int(out.num_replicas[i])
+        # exact at every boundary: ceil(3f) replicas
+        assert abs(got - expect) <= 1, (f, got, expect)
+        assert out.rate_star[i] == pytest.approx(lam, rel=2e-3), f
