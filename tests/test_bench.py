@@ -7,6 +7,7 @@ a bench run)."""
 
 import json
 import math
+import re
 import sys
 from pathlib import Path
 
@@ -77,3 +78,30 @@ def test_replica_arithmetic_matches_reference_formula(ns):
     assert tpu["replicas"] == max(
         1, math.ceil(bench.ARRIVAL_RPS / tpu["rate_per_replica"])
     )
+
+
+def test_readme_quotes_match_computed_headline(ns):
+    """Docs-contract: the README's quoted headline numbers must track the
+    bench's actual computation — a profile regeneration that shifts the
+    economics must fail here rather than ship a stale README."""
+    readme = (Path(__file__).resolve().parent.parent / "README.md").read_text()
+    # fixed-width formatting: round()'s trailing-zero drop would turn
+    # 0.120 into the substring "$0.12", which a stale "$0.125" satisfies
+    value = f"${ns['tpu']['usd_per_mtok']:.3f}"
+    assert value in readme, f"README does not quote {value}/Mtok"
+    a100 = f"${ns['a100']['usd_per_mtok']:.3f}"
+    assert a100 in readme, f"README does not quote {a100} for the A100"
+    ratio = ns["vs_baseline"]
+    assert f"{ratio:.2f}×" in readme, f"README does not quote {ratio:.2f}x"
+    # the README's quoted break-even (e.g. "~2.3× wrong") vs the computed
+    # one — read the quote from the README so both sides are checked
+    be = ns["sensitivity"]["ici_efficiency"]["break_even_multiplier"]
+    quoted = re.search(r"~(\d+\.\d+)× wrong", readme)
+    assert quoted, "README no longer quotes a '~N.N× wrong' break-even"
+    assert isinstance(be, float) and abs(be - float(quoted.group(1))) < 0.1, (
+        f"README quotes ~{quoted.group(1)}x break-even; computed {be:.2f}")
+    # secondary model headline
+    sec = ns["secondary_models"]["llama-3.2-3b"]["per_shape_usd_per_mtok"]
+    best = min(sec.values())
+    assert f"${best:.3f}" in readme, (
+        f"README does not quote the 3B best ${best:.3f}")

@@ -353,9 +353,6 @@ def test_near_saturation_lanes_match_scalar():
     chain. Decisions must still match the scalar analyzer."""
     import math
 
-    from inferno_tpu.analyzer import RequestSize, TargetPerf, build_analyzer
-    from inferno_tpu.config.types import DecodeParms, PrefillParms
-
     n = 8
     alpha, beta = 12.0, 0.25
     gamma, delta = 6.0, 0.01
@@ -385,6 +382,11 @@ def test_near_saturation_lanes_match_scalar():
     for i, f in enumerate(fracs):
         expect = max(1, math.ceil(3 * lam * f / lam))
         got = int(out.num_replicas[i])
-        # exact at every boundary: ceil(3f) replicas
-        assert abs(got - expect) <= 1, (f, got, expect)
+        if f in (0.99, 0.999, 1.0):
+            # fp at an exact ceil boundary may tip either side
+            assert abs(got - expect) <= 1, (f, got, expect)
+        else:
+            # interior fractions must be EXACT: a systematic off-by-one
+            # in the optimized argmax/underflow path would shift these
+            assert got == expect, (f, got, expect)
         assert out.rate_star[i] == pytest.approx(lam, rel=2e-3), f
