@@ -29,11 +29,15 @@ sensitivity entry in `extra.sensitivity`.
 `vs_baseline` = a100_usd_per_mtok / tpu_usd_per_mtok (>1 = the TPU fleet
 serves the same SLO-bound traffic cheaper).
 
-`extra.fleet_cycle` carries the round-2 solver metric, reframed per the
-round-2 verdict: construction excluded from the timed region, `vs_scalar`
-AND `vs_native` (C++) baselines, and a 512->4096-lane scaling row.
+`fleet_cycle` (in the full payload) carries the round-2 solver metric,
+reframed per the round-2 verdict: construction excluded from the timed
+region, `vs_scalar` AND `vs_native` (C++) baselines, and a
+512->4096-lane scaling row.
 
-Prints ONE JSON line.
+Output contract (round-4 fix): prints ONE COMPACT JSON line — headline
+metric/value/unit/vs_baseline plus a pointer — and writes the full
+payload to `bench_full.json`. The driver's stdout tail window truncated
+round 4's ~4 KB line mid-object; the compact line is asserted < 1 KB.
 """
 
 import argparse
@@ -41,6 +45,7 @@ import json
 import math
 import statistics
 import time
+from pathlib import Path
 
 import numpy as np
 
@@ -60,7 +65,6 @@ from inferno_tpu.config import (
 )
 from inferno_tpu.config.defaults import slo_margin_for
 from inferno_tpu.core import System
-from inferno_tpu.models.profiles import load_named_profile
 from inferno_tpu.parallel import calculate_fleet
 from inferno_tpu.solver import optimize
 
@@ -146,10 +150,12 @@ def size_model_shapes(model: str) -> dict:
     memory- and SLO-feasible slice shape of `model` — the autoscaler's own
     decision surface (SolveUnlimited semantics: min cost per server across
     candidate accelerators), shared by the headline and secondary tables."""
+    from inferno_tpu.models.profiles import load_named_profile_doc
+
     per_shape = {}
     for acc, (chips, chip_hr) in TPU_SHAPES.items():
         try:
-            prof = load_named_profile(model, acc)
+            prof, doc = load_named_profile_doc(model, acc)
         except FileNotFoundError:
             continue
         if prof.max_batch_size <= 0:
@@ -166,6 +172,14 @@ def size_model_shapes(model: str) -> dict:
             "gamma": prof.prefill_parms.gamma, "delta": prof.prefill_parms.delta,
             "max_batch": prof.max_batch_size, "chips": chips,
         }
+        # Provenance (round-4 verdict weak #3): a measured v5e row and a
+        # hardware-ratio-estimated v6e row must never read as equals in
+        # the output table. "measured" = fitted directly from an on-chip
+        # raw; "derived" = TP-scaled and/or cross-generation-rescaled
+        # (profile doc records which under `assumptions`).
+        per_shape[acc]["provenance"] = (
+            "derived" if doc.get("derived") else "measured"
+        )
     return per_shape
 
 
@@ -180,11 +194,13 @@ def ici_sensitivity(chosen_acc: str, a100_usd: float) -> dict | None:
     import json as _json
     from pathlib import Path
 
-    from inferno_tpu.models.profiles import PROFILES_DIR, fit_tpu_profile
-
-    prof_doc = _json.loads(
-        (PROFILES_DIR / f"llama-3.1-8b_{chosen_acc}.json").read_text()
+    from inferno_tpu.models.profiles import (
+        PROFILES_DIR,
+        fit_tpu_profile,
+        profile_path,
     )
+
+    prof_doc = _json.loads(profile_path("llama-3.1-8b", chosen_acc).read_text())
     if not prof_doc.get("derived"):
         return None  # headline is a pure measurement; no derivation risk
     n_chips = int(prof_doc["assumptions"]["n_chips"])
@@ -279,6 +295,9 @@ def north_star() -> dict:
         if by_shape:
             secondary[model] = {
                 "per_shape_usd_per_mtok": by_shape,
+                "per_shape_provenance": {
+                    a: v["provenance"] for a, v in shapes.items()
+                },
                 "best": min(by_shape, key=by_shape.get),
             }
     a100 = usd_per_mtok(A100["decode"], A100["prefill"], A100["max_batch"], A100_HR)
@@ -309,6 +328,11 @@ def north_star() -> dict:
         "chosen_shape": best_acc,
         "per_shape_usd_per_mtok": {
             a: round(v["usd_per_mtok"], 4) for a, v in per_shape.items()
+        },
+        # measured|derived per row, keyed identically to the $/Mtok table
+        # (round-4 verdict: derived estimates must not pass as measurements)
+        "per_shape_provenance": {
+            a: v["provenance"] for a, v in per_shape.items()
         },
         "a100": a100,
         "vs_baseline": a100["usd_per_mtok"] / tpu["usd_per_mtok"],
@@ -520,30 +544,104 @@ def fleet_cycle_metrics(full: bool = True) -> dict:
     return out
 
 
-def _pin_cpu_if_tpu_unreachable(timeout_s: float = 120.0) -> None:
+def _pin_cpu_if_tpu_unreachable(timeout_s: float = 120.0) -> dict:
     """The TPU on this box sits behind a network tunnel that can be down
     for hours; jax backend init then hangs forever instead of failing.
     Probe device initialization in a subprocess with a timeout and pin
     the CPU platform for this process when the probe dies, so the bench
     always produces its JSON line (fleet-cycle timings are then CPU
-    numbers; the north-star metric never needed a device)."""
+    numbers; the north-star metric never needed a device).
+
+    Returns a provenance record for the output (round-4 verdict weak #2:
+    every bench run must say whether the chip was probed and what
+    happened, not leave the reader to infer it from `platform`)."""
     import subprocess
     import sys as _sys
 
     try:
         probe = subprocess.run(
-            [_sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, timeout=timeout_s,
+            [_sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],  # same check as
+            # reconciler._tpu_device_present: platform string == "tpu"
+            capture_output=True, text=True, timeout=timeout_s,
         )
+        platform = probe.stdout.strip().splitlines()[-1] if probe.stdout.strip() else ""
+        if probe.returncode == 0 and platform == "tpu":
+            return {"probed": True, "reachable": True}
         if probe.returncode == 0:
-            return
+            # backend init succeeded but fell back to a non-TPU platform
+            # (CPU-only box, JAX_PLATFORMS=cpu in CI): the chip is absent,
+            # not hung — report that distinctly, and don't claim a TPU
+            status = f"no TPU device (default platform: {platform or '?'})"
+        else:
+            status = f"probe exited rc={probe.returncode}"
     except subprocess.TimeoutExpired:
-        pass
+        status = f"probe hung > {timeout_s:.0f}s (tunnel down)"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    print("# TPU unreachable; fleet-cycle timings measured on CPU",
+    print(f"# TPU unavailable ({status}); fleet-cycle timings on CPU",
           file=_sys.stderr)
+    return {"probed": True, "reachable": False, "detail": status}
+
+
+# anchored next to bench.py, not the CWD: the compact line's pointer must
+# resolve no matter where the driver launched the bench from
+FULL_PAYLOAD_PATH = str(Path(__file__).resolve().parent / "bench_full.json")
+
+
+def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict) -> dict:
+    """Everything the bench measures, in one document — written to
+    `bench_full.json`, NOT printed (the printed line is `compact_line`)."""
+    return {
+        "metric": "usd_per_mtok_at_p99_ttft_slo",
+        "value": round(ns["tpu"]["usd_per_mtok"], 4),
+        "unit": "USD/Mtok",
+        "vs_baseline": round(ns["vs_baseline"], 3),
+        "tpu_probe": tpu_probe,
+        "north_star": {
+            "chosen_shape": ns["chosen_shape"],
+            "per_shape_usd_per_mtok": ns["per_shape_usd_per_mtok"],
+            "per_shape_provenance": ns["per_shape_provenance"],
+            "a100_usd_per_mtok": round(ns["a100"]["usd_per_mtok"], 4),
+            "tpu_replicas": ns["tpu"]["replicas"],
+            "a100_replicas": ns["a100"]["replicas"],
+            "tpu_tok_s_per_replica": round(ns["tpu"]["tok_s_per_replica"], 1),
+            "a100_tok_s_per_replica": round(ns["a100"]["tok_s_per_replica"], 1),
+            "profile": ns["profile"],
+            "secondary_models": ns["secondary_models"],
+            "sensitivity": ns["sensitivity"],
+        },
+        "fleet_cycle": cycles,
+    }
+
+
+def compact_line(ns: dict, cycles: dict, tpu_probe: dict) -> str:
+    """The ONE printed JSON line. Round-4 postmortem: the driver captures
+    only a tail window of stdout, and round 4's ~4 KB single line was cut
+    mid-object (`BENCH_r04.json parsed: null`) — a benchmark whose number
+    the scoring pipeline can't read didn't happen. So the printed line is
+    a compact headline (well under any plausible tail window) and the full
+    payload lives in `bench_full.json`, referenced by path."""
+    line = json.dumps({
+        "metric": "usd_per_mtok_at_p99_ttft_slo",
+        "value": round(ns["tpu"]["usd_per_mtok"], 4),
+        "unit": "USD/Mtok",
+        "vs_baseline": round(ns["vs_baseline"], 3),
+        "extra": {
+            "chosen_shape": ns["chosen_shape"],
+            "headline_provenance": ns["per_shape_provenance"][ns["chosen_shape"]],
+            "a100_usd_per_mtok": round(ns["a100"]["usd_per_mtok"], 4),
+            "tpu_reachable": tpu_probe.get("reachable", False),
+            "fleet_cycle_platform": cycles["platform"],
+            "fleet_cycle_ms": cycles["auto_selected_ms"],
+            "full_payload": FULL_PAYLOAD_PATH,
+        },
+    })
+    if len(line) >= 1024:  # not an assert: must survive python -O, and an
+        # oversized line silently re-creates the round-4 truncation failure
+        raise RuntimeError(f"compact bench line grew to {len(line)}B; trim it")
+    return line
 
 
 def main() -> None:
@@ -551,34 +649,13 @@ def main() -> None:
     ap.add_argument("--quick", action="store_true",
                     help="skip the 4096-lane scaling row (CI smoke)")
     args = ap.parse_args()
-    _pin_cpu_if_tpu_unreachable()
+    tpu_probe = _pin_cpu_if_tpu_unreachable()
     ns = north_star()
     cycles = fleet_cycle_metrics(full=not args.quick)
-    print(
-        json.dumps(
-            {
-                "metric": "usd_per_mtok_at_p99_ttft_slo",
-                "value": round(ns["tpu"]["usd_per_mtok"], 4),
-                "unit": "USD/Mtok",
-                "vs_baseline": round(ns["vs_baseline"], 3),
-                "extra": {
-                    "north_star": {
-                        "chosen_shape": ns["chosen_shape"],
-                        "per_shape_usd_per_mtok": ns["per_shape_usd_per_mtok"],
-                        "a100_usd_per_mtok": round(ns["a100"]["usd_per_mtok"], 4),
-                        "tpu_replicas": ns["tpu"]["replicas"],
-                        "a100_replicas": ns["a100"]["replicas"],
-                        "tpu_tok_s_per_replica": round(ns["tpu"]["tok_s_per_replica"], 1),
-                        "a100_tok_s_per_replica": round(ns["a100"]["tok_s_per_replica"], 1),
-                        "profile": ns["profile"],
-                        "secondary_models": ns["secondary_models"],
-                        "sensitivity": ns["sensitivity"],
-                    },
-                    "fleet_cycle": cycles,
-                },
-            }
-        )
+    Path(FULL_PAYLOAD_PATH).write_text(
+        json.dumps(build_full_payload(ns, cycles, tpu_probe), indent=1) + "\n"
     )
+    print(compact_line(ns, cycles, tpu_probe))
 
 
 if __name__ == "__main__":

@@ -3,9 +3,11 @@
 The analogue of the reference's manager main
 (/root/reference/cmd/main.go:62-279): env/flag configuration, Prometheus
 client with TLS validation, metrics + health endpoints, then the
-interval-driven reconcile loop. Leader election is delegated to the
-Deployment (replicas: 1) in this build; the loop is stateless so a
-restart resumes cleanly from CR status (SURVEY §5.4).
+interval-driven reconcile loop. Leader election: single-replica
+deployments need none (the chart default); multi-replica deployments set
+LEADER_ELECT=true for lease-based election (wired below, `LeaderElector`).
+Either way the loop is stateless, so a restart resumes cleanly from CR
+status (SURVEY §5.4).
 
 Environment (reference parity: internal/utils/tls.go:101-118 and
 controller.go:516-582):

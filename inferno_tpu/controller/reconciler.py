@@ -70,14 +70,17 @@ from inferno_tpu.controller.constants import (  # noqa: E402,F401 (re-export)
 )
 
 
-def _tpu_device_present(timeout_s: float = 60.0) -> bool:
+def _tpu_device_present(timeout_s: float = 20.0) -> bool:
     """Whether a TPU device is actually attached and initializable.
 
     Probed in a SUBPROCESS with a timeout: when a TPU is configured but
     unreachable (e.g. tunnel down), jax backend initialization hangs
     instead of failing — a controller pod must degrade to the native
     backend, not hang at startup. Same technique as bench.py's
-    `_pin_cpu_if_tpu_unreachable`."""
+    `_pin_cpu_if_tpu_unreachable`. The timeout bounds Reconciler init
+    (r4 advisor: 60s was a silent one-minute startup stall under the
+    default compute_backend=auto); a healthy attached TPU initializes in
+    a few seconds, so 20s is a generous hang cutoff, not a race."""
     import subprocess
     import sys
 
@@ -96,6 +99,14 @@ def _tpu_device_present(timeout_s: float = 60.0) -> bool:
 def resolve_compute_backend() -> str:
     """'auto' resolution: tpu if a device is present, else the C++ native
     solver if it builds/loads, else the scalar fallback."""
+    from inferno_tpu.controller.logger import get_logger
+
+    # announce BEFORE the probe (r4 advisor): if the probe has to wait
+    # out its hang timeout, the operator sees why startup is pausing
+    # instead of a silent stall
+    get_logger().info("compute-backend auto resolution: probing for a TPU "
+                      "device (bounded at 20s; a hung TPU tunnel degrades "
+                      "to the native backend)")
     if _tpu_device_present():
         return "tpu"
     from inferno_tpu import native

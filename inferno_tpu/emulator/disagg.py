@@ -13,10 +13,14 @@ aggregated path has:
 * prefill pool — `prefill_engines` threads batching waiting prompts up
   to `prefill_max_batch`; an iteration costs gamma + delta·in_tokens·B
   (the analyzer's mu_p(n) curve) and produces the FIRST token (TTFT is
-  stamped at prefill completion, as JetStream reports it);
+  stamped at prefill completion, as JetStream reports it — BEFORE the
+  KV transfer below);
 * KV transfer — a fixed `kv_transfer_ms` between prefill completion and
-  decode admission (the analyzer folds this into gamma; tests can
-  account for it the same way);
+  decode admission. The tandem analyzer folds this into its prefill
+  gamma, so ITS predicted TTFT includes the handoff while the emulator's
+  measured TTFT does not: model-vs-emulator TTFT comparisons must
+  subtract kv_transfer_ms from the prediction
+  (emulator/experiment.py `_model_prediction` does);
 * decode pool — `decode_engines` threads running generation-only steps
   alpha + beta·B for the remaining out_tokens-1 tokens (mu_d(n)).
 
@@ -34,6 +38,7 @@ wall msec / time_scale uniformly across the tandem).
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 import threading
 import time
@@ -68,9 +73,15 @@ class DisaggEngine:
         self.lock = threading.Lock()
         self.stop_flag = False
         # shared queues: prompts awaiting a prefill engine; prefilled
-        # requests awaiting a decode slot, gated by the KV-transfer time
+        # requests awaiting a decode slot, gated by the KV-transfer time.
+        # decode_waiting is kept SORTED by ready_wall (r4 advisor): with
+        # multiple prefill engines, completion times interleave out of
+        # append order, and the FIFO admission loop below must not block
+        # an already-transferred request behind a not-yet-ready head.
+        # (Blocking on the *KV check* is intentional FIFO admission —
+        # large requests are not starved by smaller later arrivals.)
         self.prefill_waiting: deque[_Request] = deque()
-        self.decode_waiting: deque[tuple[float, _Request]] = deque()
+        self.decode_waiting: list[tuple[float, _Request]] = []
         # per-engine running sets (index 0..prefill_engines-1, etc.)
         self._prefill_running: list[list[_Request]] = [
             [] for _ in range(profile.prefill_engines)
@@ -154,6 +165,8 @@ class DisaggEngine:
             return len(self.prefill_waiting) + len(self.decode_waiting)
 
     def kv_used_fraction(self) -> float:
+        """Actual KV in use (in + generated-so-far); the admission gate
+        reserves in+out instead, so this gauge can't exceed 1.0."""
         cap = self.profile.kv_tokens_capacity * self.profile.decode_engines
         with self.lock:
             used = sum(
@@ -196,7 +209,8 @@ class DisaggEngine:
                         self._finish(r, now)
                         finished.append(r)
                     else:
-                        self.decode_waiting.append((ready_wall, r))
+                        bisect.insort(self.decode_waiting, (ready_wall, r),
+                                      key=lambda t: t[0])
                 running.clear()
             for r in finished:
                 r.done_event.set()
@@ -207,17 +221,21 @@ class DisaggEngine:
         while not self.stop_flag:
             now = time.time()
             with self.lock:
-                kv_used = sum(r.in_tokens + r.tokens_done for r in running)
-                # admit transferred requests whose KV has arrived
+                # reservation-based KV admission, matching engine._admit
+                # (r4 advisor): running requests reserve in+out so the
+                # aggregate can't outgrow capacity as they decode
+                kv_used = sum(r.in_tokens + r.out_tokens for r in running)
+                # admit transferred requests whose KV has arrived, in
+                # ready_wall order (the list is sorted at insertion)
                 while self.decode_waiting and len(running) < p.decode_max_batch:
                     ready_wall, nxt = self.decode_waiting[0]
                     if ready_wall > now:
                         break
                     if kv_used + nxt.in_tokens + nxt.out_tokens > p.kv_tokens_capacity:
-                        break  # KV admission control
-                    self.decode_waiting.popleft()
+                        break  # KV admission control (FIFO, anti-starvation)
+                    self.decode_waiting.pop(0)
                     running.append(nxt)
-                    kv_used += nxt.in_tokens + nxt.tokens_done
+                    kv_used += nxt.in_tokens + nxt.out_tokens
                 batch = len(running)
             if batch == 0:
                 time.sleep(0.0005)

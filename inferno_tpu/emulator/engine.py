@@ -160,6 +160,10 @@ class EmulatedEngine:
         return len(self.waiting)
 
     def kv_used_fraction(self) -> float:
+        """Fraction of KV capacity in ACTUAL use (in + generated-so-far)
+        — a telemetry gauge, deliberately not the reservation sum that
+        `_admit` gates on; with reservation-based admission it can never
+        exceed 1.0."""
         with self.lock:
             used = sum(r.in_tokens + r.tokens_done for r in self.running)
         return min(used / self.profile.kv_tokens_capacity, 1.0)
@@ -174,7 +178,12 @@ class EmulatedEngine:
             # wait-clock here. Admissions into a busy batch keep their
             # stamps — waiting out the in-flight step is real queueing.
             was_idle = not self.running
-            kv_used = sum(r.in_tokens + r.tokens_done for r in self.running)
+            # Reservation-based admission (r4 advisor): every running
+            # request reserves its FULL in+out footprint, matching the
+            # candidate's accounting — otherwise aggregate KV can exceed
+            # capacity later as admitted requests generate tokens (this
+            # emulator has no preemption to recover with).
+            kv_used = sum(r.in_tokens + r.out_tokens for r in self.running)
             while self.waiting and len(self.running) < self.profile.max_batch:
                 nxt = self.waiting[0]
                 if kv_used + nxt.in_tokens + nxt.out_tokens > self.profile.kv_tokens_capacity:
@@ -183,7 +192,7 @@ class EmulatedEngine:
                 if was_idle:
                     nxt.arrived_emu = max(nxt.arrived_emu, self.emu_ms)
                 self.running.append(nxt)
-                kv_used += nxt.in_tokens
+                kv_used += nxt.in_tokens + nxt.out_tokens
 
     def _loop(self) -> None:
         p = self.profile

@@ -131,8 +131,15 @@ def _model_prediction(scenario: Scenario, per_replica_rps: float) -> dict[str, A
         m = analyzer.analyze(per_replica_rps)
     except Exception as exc:  # over the stability limit etc.
         return {"error": str(exc)}
+    # TTFT convention (r4 advisor): the tandem analyzer's gamma includes
+    # kv_transfer_ms (folded above), so its TTFT is decode-admission
+    # time; the emulator stamps TTFT at prefill completion, before the
+    # transfer (JetStream semantics — disagg.py module docstring).
+    # Subtract the handoff so both sides speak the emulator's convention.
+    ttft = m.ttft - (scenario.disagg.kv_transfer_ms
+                     if scenario.disagg is not None else 0.0)
     return {
-        "ttft_ms": m.ttft,
+        "ttft_ms": ttft,
         "itl_ms": m.avg_token_time,
         "rho": m.rho,
         "concurrency": m.avg_num_in_serv,

@@ -529,4 +529,17 @@ def load_profile(path: str | Path) -> ModelPerfSpec:
 
 def load_named_profile(model: str, acc: str) -> ModelPerfSpec:
     """Load profiles/<model>_<acc>.json from the repo profile store."""
-    return load_profile(PROFILES_DIR / f"{model}_{acc}.json")
+    return load_profile(profile_path(model, acc))
+
+
+def profile_path(model: str, acc: str) -> Path:
+    """The one owner of the store's naming convention."""
+    return PROFILES_DIR / f"{model}_{acc}.json"
+
+
+def load_named_profile_doc(model: str, acc: str) -> tuple[ModelPerfSpec, dict]:
+    """(spec, raw document) — for consumers that also need fit/provenance
+    metadata the wire-format spec drops (`derived`, `assumptions`, ...).
+    Raises FileNotFoundError when the shape is not in the store."""
+    doc = json.loads(profile_path(model, acc).read_text())
+    return ModelPerfSpec.from_dict(doc), doc

@@ -466,8 +466,10 @@ class MiniApiServer:
                     "application/json-patch+json",
                     "application/apply-patch+yaml",
                 }
-                if ctype and ctype not in known:
-                    return self._status(415, "UnsupportedMediaType", ctype)
+                if ctype not in known:  # absent counts: kube-apiserver 415s
+                    # a PATCH with no declared patch type too (r4 advisor)
+                    return self._status(
+                        415, "UnsupportedMediaType", ctype or "(no Content-Type)")
                 is_json_patch = ctype == "application/json-patch+json"
                 body = self._read_body()
                 if body is None:

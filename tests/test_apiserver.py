@@ -641,6 +641,29 @@ class TestConformancePatchDialect:
                           {"spec": {"replicas": 2}}, ctype="text/plain")
         assert code == 415
 
+    def test_missing_patch_content_type_415(self, server):
+        """kube-apiserver 415s a PATCH with no declared patch type; the
+        fake must not be laxer and quietly merge-patch (r4 advisor).
+        urllib silently injects a default Content-Type on bodied requests,
+        so speak raw http.client to truly omit the header."""
+        import http.client
+        from urllib.parse import urlparse
+
+        path = self._lws(server)
+        u = urlparse(server.url)
+        body = json.dumps({"spec": {"replicas": 2}}).encode()
+        conn = http.client.HTTPConnection(u.hostname, u.port, timeout=5)
+        try:
+            conn.putrequest("PATCH", path + "/scale")
+            conn.putheader("Content-Length", str(len(body)))
+            conn.endheaders()
+            conn.send(body)
+            assert conn.getresponse().status == 415
+        finally:
+            conn.close()
+        _, lws = request(server, path, "GET")
+        assert lws["spec"]["replicas"] == 1  # nothing applied
+
     def test_json_patch_test_op_conflict(self, server):
         """RFC 6902 `test` is the optimistic-concurrency idiom on the
         patch path; a failing test is kube's 409."""

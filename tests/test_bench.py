@@ -59,6 +59,45 @@ def test_caveats_first_class(ns):
     assert s["tpu_capped_at_batch64_usd_per_mtok"] > ns["tpu"]["usd_per_mtok"]
 
 
+def test_compact_line_fits_tail_window(ns):
+    """Round-4 postmortem: BENCH_r04 parsed:null because the single output
+    line outgrew the driver's stdout tail window. The printed line must
+    stay compact and strict-JSON, with the full payload behind a pointer."""
+    cycles = {"platform": "cpu", "auto_selected_ms": 84.0}
+    probe = {"probed": True, "reachable": False, "detail": "probe hung"}
+    line = bench.compact_line(ns, cycles, probe)
+    assert len(line) < 1024
+    doc = json.loads(line)
+    assert doc["metric"] == "usd_per_mtok_at_p99_ttft_slo"
+    assert doc["value"] == pytest.approx(ns["tpu"]["usd_per_mtok"], rel=1e-3)
+    assert doc["vs_baseline"] == pytest.approx(ns["vs_baseline"], rel=1e-2)
+    assert doc["extra"]["full_payload"] == bench.FULL_PAYLOAD_PATH
+    assert doc["extra"]["tpu_reachable"] is False
+    # the full payload carries everything the old fat line did
+    full = bench.build_full_payload(ns, cycles, probe)
+    assert "sensitivity" in full["north_star"]
+    assert full["north_star"]["secondary_models"]
+    assert full["tpu_probe"]["detail"] == "probe hung"
+
+
+def test_every_per_shape_row_has_provenance(ns):
+    """Round-4 verdict weak #3: measured (v5e raw-anchored) and derived
+    (TP-scaled / cross-generation) rows must be distinguishable in the
+    output, keyed identically to the $/Mtok table."""
+    table = ns["per_shape_usd_per_mtok"]
+    prov = ns["per_shape_provenance"]
+    assert set(prov) == set(table)
+    assert set(prov.values()) <= {"measured", "derived"}
+    # v5e-1 is the pure on-chip measurement; every multi-chip and every
+    # cross-generation shape stacks at least one derivation step
+    assert prov["v5e-1"] == "measured"
+    for acc, p in prov.items():
+        if acc.startswith(("v5p", "v6e")):
+            assert p == "derived", f"{acc} is a hardware-ratio estimate"
+    sec = ns["secondary_models"]["llama-3.2-3b"]
+    assert set(sec["per_shape_provenance"]) == set(sec["per_shape_usd_per_mtok"])
+
+
 def test_north_star_is_strict_json(ns):
     # the bench output contract: one RFC-8259 line; Infinity/NaN would
     # break jq / Go / JSON.parse consumers (review r4)
