@@ -142,6 +142,14 @@ TPU_SHAPES = {  # committed profile name -> (chips, $/chip-hr)
     "v6e-8": (8, V6E_CHIP_HR),
     "v6e-4-int8": (4, V6E_CHIP_HR),
     "v6e-8-int8": (8, V6E_CHIP_HR),
+    # multi-host slices (4 hosts x 4 chips), the 70B serving shapes of
+    # BASELINE config #5 — scaled as whole LeaderWorkerSet groups
+    "v5e-16": (16, V5E_CHIP_HR),
+    "v5e-16-int8": (16, V5E_CHIP_HR),
+    "v5p-16": (16, V5P_CHIP_HR),
+    "v5p-16-int8": (16, V5P_CHIP_HR),
+    "v6e-16": (16, V6E_CHIP_HR),
+    "v6e-16-int8": (16, V6E_CHIP_HR),
 }
 
 
@@ -289,7 +297,7 @@ def north_star() -> dict:
     # same machinery at the same SLO/workload (no A100 baseline exists for
     # them in the reference; reported for breadth, not the headline)
     secondary = {}
-    for model in ("llama-3.2-3b",):
+    for model in ("llama-3.2-3b", "llama-3.1-70b"):
         shapes = size_model_shapes(model)
         by_shape = {a: round(v["usd_per_mtok"], 4) for a, v in shapes.items()}
         if by_shape:
@@ -611,6 +619,20 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict) -> dict:
             "profile": ns["profile"],
             "secondary_models": ns["secondary_models"],
             "sensitivity": ns["sensitivity"],
+        },
+        # BASELINE config #5 (multi-host 70B on 16-chip slices, scaled as
+        # whole LWS groups of 4 hosts): surfaced at top level; rows are
+        # sized by the same machinery at the same Premium-p99 SLO. All
+        # rows are DERIVED (cross-model rescale of the measured 8B sweep
+        # — profile assumptions.cross_model) until a 70B on-chip raw
+        # lands; per_shape_provenance says so row by row.
+        "llama_70b": {
+            # fail loudly if the committed 70B profiles went missing —
+            # an empty config-#5 table must never ship silently
+            **ns["secondary_models"]["llama-3.1-70b"],
+            "slice_hosts": 4,
+            "note": "16-chip slices actuated as LeaderWorkerSet groups "
+                    "(tests/test_e2e_llama70b.py)",
         },
         "fleet_cycle": cycles,
     }

@@ -65,6 +65,14 @@ class LlamaDims:
 # out of the box; any other architecture is a LlamaDims(...) away.
 MODEL_PRESETS: dict[str, LlamaDims] = {
     "llama-3.1-8b": LlamaDims(),
+    # BASELINE config #5's multi-host model (80 layers, 8192 hidden,
+    # GQA-8): a full-depth bf16 70B is ~141 GB of weights, so on-chip
+    # profiling runs reduced depths (--layer-depths) and the layer
+    # regression extrapolates — even a single 16 GB v5e chip fits a
+    # 2-4 layer sub-stack of it
+    "llama-3.1-70b": LlamaDims(hidden=8192, n_heads=64, n_kv_heads=8,
+                               head_dim=128, ffn=28672, vocab=128256,
+                               n_layers=80),
     "llama-3.2-3b": LlamaDims(hidden=3072, n_heads=24, n_kv_heads=8,
                               head_dim=128, ffn=8192, vocab=128256,
                               n_layers=28),

@@ -51,22 +51,21 @@ class UnfittableRawError(ValueError):
     can skip it without masking real corruption."""
 
 
-def _extrapolate_layers(
-    samples: list[dict], key: str, group_keys: tuple[str, ...], n_layers_full: int
-) -> tuple[list[dict], float]:
-    """Group samples by `group_keys`, regress time against n_layers within
-    each group, return full-model points and the worst R^2 across groups."""
+def _per_group_line_fits(
+    samples: list[dict], key: str, group_keys: tuple[str, ...]
+) -> dict[tuple, tuple[float, float, list[int], float]]:
+    """{group -> (intercept, slope, depths, r2)} of `key`-vs-n_layers
+    lines — the single owner of the depth regression, shared by the
+    full-model extrapolation and the cross-model rescale. Single-depth
+    groups (a partially-measured sweep resumed after a tunnel outage)
+    are skipped; raises UnfittableRawError when NO group has >=2 depths."""
     groups: dict[tuple, list[dict]] = {}
     for s in samples:
         groups.setdefault(tuple(s[k] for k in group_keys), []).append(s)
-    out = []
-    worst_r2 = 1.0
+    out = {}
     skipped = 0
     for gkey, pts in sorted(groups.items()):
         if len(pts) < 2:
-            # a partially-measured sweep (e.g. resumed after a tunnel
-            # outage) may have single-depth groups: skip them rather than
-            # reject the whole file — unless nothing is extrapolatable
             skipped += 1
             continue
         ls = np.array([p["n_layers"] for p in pts], dtype=np.float64)
@@ -78,17 +77,27 @@ def _extrapolate_layers(
         ss_res = float(np.sum((ts - pred) ** 2))
         ss_tot = float(np.sum((ts - ts.mean()) ** 2))
         r2 = 1.0 - ss_res / ss_tot if ss_tot > 0 else 1.0
-        worst_r2 = min(worst_r2, r2)
-        full = max(c, 0.0) + m * n_layers_full
-        rec = dict(zip(group_keys, gkey))
-        rec[key] = full
-        out.append(rec)
+        out[gkey] = (max(c, 0.0), m, sorted({p["n_layers"] for p in pts}), r2)
     if not out:
         raise UnfittableRawError(
             f"need >=2 layer depths for at least one point; "
             f"all {skipped} groups single-depth"
         )
-    return out, worst_r2
+    return out
+
+
+def _extrapolate_layers(
+    samples: list[dict], key: str, group_keys: tuple[str, ...], n_layers_full: int
+) -> tuple[list[dict], float]:
+    """Group samples by `group_keys`, regress time against n_layers within
+    each group, return full-model points and the worst R^2 across groups."""
+    lines = _per_group_line_fits(samples, key, group_keys)
+    out = []
+    for gkey, (c, m, _depths, _r2) in lines.items():
+        rec = dict(zip(group_keys, gkey))
+        rec[key] = c + m * n_layers_full
+        out.append(rec)
+    return out, min(r2 for _, _, _, r2 in lines.values())
 
 
 def synthesize_full_model(raw: Mapping[str, Any], n_layers_full: int = 32):
@@ -373,6 +382,99 @@ def rescale_raw_cross_generation(raw: Mapping[str, Any], src, dst) -> dict:
     return out
 
 
+def rescale_raw_cross_model(raw: Mapping[str, Any], dst_dims: LlamaDims,
+                            dst_model: str) -> dict:
+    """Rescale a measured raw sweep of one Llama-family model to an
+    analytic estimate for another (e.g. the measured 8B -> 70B while the
+    chip is unreachable for a direct reduced-depth measurement).
+
+    Physics, applied to the per-group time-vs-depth LINE rather than the
+    raw totals so the depth-independent part is not over-scaled:
+
+    * decode slope (per-layer step cost, HBM-read-bound): scales with the
+      per-layer traffic ratio — weight bytes (at the measured dtype) plus
+      the batch's KV read (batch * context * kv_bytes_per_token; GQA-8
+      Llamas share kv_dim, so this term is typically unchanged);
+    * prefill slope (per-layer chunk cost, MXU-bound): scales with the
+      per-layer FLOPs ratio at the group's (batch, in_tokens) — matmul
+      FLOPs 2*params_layer per token plus the quadratic attention term;
+    * mixed slope: max of the two (the slower-improving component bounds
+      a shared continuous-batching iteration — same convention as the
+      cross-generation rescale);
+    * intercepts (LM head + final norm + loop overhead): scale with
+      `hidden` (the LM-head read is hidden*vocab bytes; loop overhead,
+      which does not scale at all, is small) — slightly pessimistic for
+      models whose layer ratio exceeds the hidden ratio.
+
+    Samples are re-emitted at the measured depths from the scaled lines,
+    so the output is exactly depth-linear (r2 = 1.0 downstream — a
+    synthetic sweep, which is why consumers must mark it derived with
+    `cross_model` assumptions). The profile pipeline then applies the
+    destination model's own memory cap, TP derivation, and error bars."""
+    src_in = dict(raw["meta"]["dims"])
+    src_layers_full = src_in.pop("n_layers_full")
+    src = LlamaDims(**src_in, n_layers=src_layers_full)
+    # the profiler records the ACTIVATION dtype under meta.dtype (always
+    # bfloat16) and the weight storage under meta.weight_dtype — the
+    # decode traffic ratio must use the weight bytes (int8 sweeps move
+    # half the weight bytes of bf16 ones)
+    wdtype = raw["meta"].get("weight_dtype") or raw["meta"].get("dtype")
+    wbytes = 1 if wdtype == "int8" else 2
+
+    def layer_bytes(d: LlamaDims) -> float:
+        return d.layer_params_bytes(dtype_bytes=wbytes)
+
+    def kv_read_bytes(d: LlamaDims, batch: float, context: float) -> float:
+        return batch * context * 2 * d.kv_dim * 2  # bf16 KV
+
+    def layer_flops(d: LlamaDims, batch: float, tokens: float) -> float:
+        matmul = 2.0 * d.layer_params_bytes(dtype_bytes=1) * batch * tokens
+        attn = 2.0 * batch * tokens * tokens * d.q_dim
+        return matmul + attn
+
+    def decode_scale(batch: float, context: float) -> float:
+        return (layer_bytes(dst_dims) + kv_read_bytes(dst_dims, batch, context)) / (
+            layer_bytes(src) + kv_read_bytes(src, batch, context)
+        )
+
+    def prefill_scale(batch: float, tokens: float) -> float:
+        return layer_flops(dst_dims, batch, tokens) / layer_flops(src, batch, tokens)
+
+    icpt_scale = dst_dims.hidden / src.hidden
+
+    def rebuild(samples, key, group_keys, slope_scale):
+        lines = _per_group_line_fits(list(samples), key, group_keys)
+        out = []
+        for gkey, (c, m, depths, _r2) in sorted(lines.items()):
+            scale = slope_scale(*(float(g) for g in gkey))
+            extra = dict(zip(group_keys, gkey))
+            for L in depths:
+                out.append({"n_layers": L, **extra,
+                            key: c * icpt_scale + m * scale * L})
+        return out
+
+    ctx = float(raw["meta"].get("decode_context", 1024))
+    out = {k: v for k, v in raw.items() if k not in ("decode", "prefill", "mixed")}
+    out["meta"] = dict(raw["meta"])
+    out["meta"]["model"] = dst_model
+    out["meta"]["dims"] = {
+        "hidden": dst_dims.hidden, "n_heads": dst_dims.n_heads,
+        "n_kv_heads": dst_dims.n_kv_heads, "head_dim": dst_dims.head_dim,
+        "ffn": dst_dims.ffn, "vocab": dst_dims.vocab,
+        "n_layers_full": dst_dims.n_layers,
+    }
+    out["decode"] = rebuild(raw.get("decode", []), "step_ms", ("batch",),
+                            lambda b: decode_scale(b, ctx))
+    out["prefill"] = rebuild(raw.get("prefill", []), "prefill_ms",
+                             ("batch", "in_tokens"), prefill_scale)
+    if raw.get("mixed"):
+        out["mixed"] = rebuild(
+            raw["mixed"], "step_ms", ("batch", "in_tokens"),
+            lambda b, t: max(decode_scale(b, ctx), prefill_scale(1.0, t)),
+        )
+    return out
+
+
 def build_profile_json(
     raw: Mapping[str, Any],
     acc: str,
@@ -383,6 +485,7 @@ def build_profile_json(
     ici_bw_gbs: float = 45.0,
     ici_latency_us: float = 1.0,
     cross_generation: Mapping[str, Any] | None = None,
+    cross_model: Mapping[str, Any] | None = None,
 ) -> dict:
     """Full profile document for one (model, slice shape)."""
     dims_in = dict(raw["meta"]["dims"])
@@ -398,7 +501,7 @@ def build_profile_json(
         )
 
     fitted, synth_meta = fit(1.0)
-    derived = n_chips > 1 or cross_generation is not None
+    derived = n_chips > 1 or cross_generation is not None or cross_model is not None
     max_batch = max_batch_from_memory(
         dims, hbm_per_chip_gb, at_tokens,
         weight_bytes_per_param=weight_bytes_per_param, n_chips=n_chips,
@@ -442,6 +545,7 @@ def build_profile_json(
             "hbm_per_chip_gb": hbm_per_chip_gb,
             **({"cross_generation": dict(cross_generation)}
                if cross_generation else {}),
+            **({"cross_model": dict(cross_model)} if cross_model else {}),
         },
         "measurement_meta": dict(raw["meta"]),
     }

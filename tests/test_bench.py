@@ -98,6 +98,24 @@ def test_every_per_shape_row_has_provenance(ns):
     assert set(sec["per_shape_provenance"]) == set(sec["per_shape_usd_per_mtok"])
 
 
+def test_llama_70b_multihost_table(ns):
+    """BASELINE config #5: the bench carries a 70B per-shape table over
+    the 16-chip multi-host slices, every row marked derived (no on-chip
+    70B raw exists yet), priced plausibly above the 8B (a ~9x model can't
+    serve cheaper per token on the same silicon at the same SLO)."""
+    sec = ns["secondary_models"]["llama-3.1-70b"]
+    table = sec["per_shape_usd_per_mtok"]
+    assert "v5e-16-int8" in table and "v5p-16-int8" in table
+    assert all(a.endswith("-16") or a.endswith("-16-int8") for a in table)
+    assert set(sec["per_shape_provenance"].values()) == {"derived"}
+    assert min(table.values()) > ns["tpu"]["usd_per_mtok"]
+    # the full payload surfaces it at top level with the LWS group story
+    cycles = {"platform": "cpu", "auto_selected_ms": 84.0}
+    full = bench.build_full_payload(ns, cycles, {"probed": True, "reachable": False})
+    assert full["llama_70b"]["slice_hosts"] == 4
+    assert full["llama_70b"]["per_shape_usd_per_mtok"] == table
+
+
 def test_north_star_is_strict_json(ns):
     # the bench output contract: one RFC-8259 line; Infinity/NaN would
     # break jq / Go / JSON.parse consumers (review r4)
@@ -144,3 +162,8 @@ def test_readme_quotes_match_computed_headline(ns):
     best = min(sec.values())
     assert f"${best:.3f}" in readme, (
         f"README does not quote the 3B best ${best:.3f}")
+    # 70B multi-host quote (the README names the v5e-16 int8 row, not the
+    # global min — v5e-16 is the BASELINE config #5 shape)
+    v70 = ns["secondary_models"]["llama-3.1-70b"]["per_shape_usd_per_mtok"]
+    assert f"${v70['v5e-16-int8']:.3f}" in readme, (
+        f"README does not quote the 70B v5e-16 ${v70['v5e-16-int8']:.3f}")

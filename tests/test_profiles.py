@@ -305,6 +305,87 @@ def test_derived_profiles_respect_hbm_roofline():
                 assert util <= base * 1.001, (acc, util, base)
 
 
+def test_cross_model_rescale_scales_slope_and_intercept_separately():
+    """The 8B->70B rescale must scale the per-layer slope by the traffic/
+    FLOPs ratio and the depth-independent intercept by the hidden ratio —
+    scaling raw totals uniformly would over-scale the LM-head term."""
+    from inferno_tpu.models.llama_block import MODEL_PRESETS
+    from inferno_tpu.models.profiles import rescale_raw_cross_model
+
+    raw = fake_raw()
+    raw["meta"]["dtype"] = "bfloat16"
+    dst = MODEL_PRESETS["llama-3.1-70b"]
+    src = LlamaDims()
+    out = rescale_raw_cross_model(raw, dst, "llama-3.1-70b")
+
+    assert out["meta"]["model"] == "llama-3.1-70b"
+    assert out["meta"]["dims"]["n_layers_full"] == 80
+
+    # decode at batch=1: per-layer traffic = weight bytes + 1024-token KV
+    # read; kv_dim is identical (GQA-8), so the ratio is weight-dominated
+    kv = 1 * 1024 * 2 * src.kv_dim * 2
+    ratio = (dst.layer_params_bytes(2) + kv) / (src.layer_params_bytes(2) + kv)
+    icpt = dst.hidden / src.hidden
+    by_depth = {s["n_layers"]: s["step_ms"] for s in out["decode"] if s["batch"] == 1}
+    # recover slope/intercept from two depths and compare to ground truth
+    slope = (by_depth[8] - by_depth[2]) / 6
+    intercept = by_depth[2] - 2 * slope
+    assert slope == pytest.approx((TRUE_LAYER_MS + TRUE_BETA_PER_LAYER) * ratio, rel=1e-6)
+    assert intercept == pytest.approx(TRUE_HEAD_MS * icpt, rel=1e-6)
+
+    # prefill slope scales by the FLOPs ratio at that (batch, tokens)
+    def flops(d, b, t):
+        return 2.0 * d.layer_params_bytes(1) * b * t + 2.0 * b * t * t * d.q_dim
+
+    t = 512
+    fr = flops(dst, 1, t) / flops(src, 1, t)
+    pre = {s["n_layers"]: s["prefill_ms"] for s in out["prefill"]
+           if s["batch"] == 1 and s["in_tokens"] == t}
+    pslope = (pre[8] - pre[2]) / 6
+    assert pslope == pytest.approx(TRUE_PREFILL_PER_LAYER_PER_TOK * t * fr, rel=1e-6)
+
+
+def test_committed_70b_profiles_are_derived_with_cross_model_assumptions():
+    """BASELINE config #5's profiles exist for the multi-host shapes and
+    honestly declare their provenance: derived, cross_model assumptions,
+    donor recorded, error bars present, memory cap physically sane."""
+    shapes = ["v5e-16", "v5e-16-int8", "v5p-16-int8", "v6e-16-int8"]
+    for acc in shapes:
+        path = PROFILES_DIR / f"llama-3.1-70b_{acc}.json"
+        assert path.exists(), f"missing 70B profile {acc}"
+        doc = json.loads(path.read_text())
+        assert doc["derived"] is True
+        cm = doc["assumptions"]["cross_model"]
+        assert cm["donor_model"] == "llama-3.1-8b"
+        assert "derivationErrorBars" in doc
+        assert doc["assumptions"]["n_chips"] == 16
+        # a 70B fits a 16-chip slice with real batch headroom, and the
+        # cap must stay below the 8B's equivalent (9x the weights)
+        assert 0 < doc["maxBatchSize"] < 5000
+    # int8 v5e-16: ~71 GB weights in 256 GB HBM -> max batch within 25%
+    # of the hand-computed KV budget
+    doc = json.loads((PROFILES_DIR / "llama-3.1-70b_v5e-16-int8.json").read_text())
+    from inferno_tpu.models.llama_block import MODEL_PRESETS
+    dims = MODEL_PRESETS["llama-3.1-70b"]
+    params = dims.n_layers * dims.layer_params_bytes(1) + 2 * dims.hidden * dims.vocab
+    free_gb = 16 * 16.0 - params / 2**30 - 16.0
+    kv_per_req = doc["atTokens"] * dims.kv_bytes_per_token() / 2**30
+    assert doc["maxBatchSize"] == pytest.approx(free_gb / kv_per_req, rel=0.25)
+
+
+def test_70b_decode_slope_exceeds_8b_at_same_chips():
+    """Physics guard on the derivation: a 70B layer stack moves ~4x the
+    bytes of the 8B per step, on 80 vs 32 layers — its per-chip-count
+    decode parms must be strictly slower than the 8B's at every shared
+    chip count (the derivation can never make the bigger model faster)."""
+    small = json.loads((PROFILES_DIR / "llama-3.1-8b_v5e-8-int8.json").read_text())
+    big = json.loads((PROFILES_DIR / "llama-3.1-70b_v5e-16-int8.json").read_text())
+    # even with 2x the chips, the 70B's alpha (weight-read floor) exceeds
+    # the 8B's on half the chips
+    assert big["decodeParms"]["alpha"] > small["decodeParms"]["alpha"]
+    assert big["prefillParms"]["gamma"] > small["prefillParms"]["gamma"]
+
+
 def test_derived_profiles_carry_error_bars():
     """Derived profiles record the ICI-model parm band; measured ones
     don't. The base parms must sit inside their own band."""

@@ -27,12 +27,14 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from inferno_tpu.config.tpu_catalog import TPU_GENERATIONS
+from inferno_tpu.models.llama_block import MODEL_PRESETS
 from inferno_tpu.models.profiles import (
     PROFILES_DIR,
     UnfittableRawError,
     attach_context_buckets,
     build_profile_json,
     rescale_raw_cross_generation,
+    rescale_raw_cross_model,
 )
 
 RAW_DIR = PROFILES_DIR / "raw"
@@ -42,6 +44,21 @@ RAW_DIR = PROFILES_DIR / "raw"
 # rescale_raw_cross_generation): the heterogeneous-pool economics of
 # BASELINE config #4 need v5p/v6e profiles that are not invented numbers.
 CROSS_GEN_SHAPES = [("v5p", 8), ("v6e", 4), ("v6e", 8)]
+
+# Cross-MODEL derivations (BASELINE config #5: multi-host 70B): built
+# ONLY when the target model has no raw measurement of its own — a real
+# `tools/profile_tpu.py --model llama-3.1-70b` run (reduced depths fit a
+# single chip; see MODEL_PRESETS) always takes precedence. Shapes are
+# multi-host slices; profiles are marked derived with `cross_model`
+# assumptions and carry the standard ICI error bars.
+CROSS_MODEL = {
+    "llama-3.1-70b": {
+        "from": "llama-3.1-8b",
+        # (generation, chips, dtype suffixes): v5e-16 is the BASELINE
+        # config, v5p-16/v6e-16 the cross-generation economics rows
+        "shapes": [("v5e", 16), ("v5p", 16), ("v6e", 16)],
+    },
+}
 
 
 def context_raws(model: str, dtype_suffix: str) -> list[tuple[int, dict]]:
@@ -143,6 +160,53 @@ def build_model(model: str) -> dict[str, dict]:
     return outputs
 
 
+def build_cross_model(model: str) -> dict[str, dict]:
+    """Profiles for a model with NO raw of its own, rescaled from a
+    measured donor (rescale_raw_cross_model), then run through the exact
+    same fit/TP/cross-generation pipeline as a measured raw."""
+    cfg = CROSS_MODEL[model]
+    donor = cfg["from"]
+    dst_dims = MODEL_PRESETS[model]
+    outputs: dict[str, dict] = {}
+    for dtype_suffix, wbytes in (("", 2.0), ("_int8", 1.0)):
+        donor_path = RAW_DIR / f"{donor}_tpu{dtype_suffix}.json"
+        if not donor_path.exists():
+            continue
+        donor_raw = json.loads(donor_path.read_text())
+        raw = rescale_raw_cross_model(donor_raw, dst_dims, model)
+        cm_meta = {
+            "donor_model": donor,
+            "donor_raw": donor_path.name,
+            "method": "per-layer bytes/FLOPs rescale of the measured "
+                      "donor sweep (rescale_raw_cross_model)",
+        }
+        src = TPU_GENERATIONS["v5e"]
+        for gen_name, chips in cfg["shapes"]:
+            dst = TPU_GENERATIONS[gen_name]
+            gen_raw = raw if gen_name == "v5e" else rescale_raw_cross_generation(
+                raw, src, dst)
+            cross_gen = None if gen_name == "v5e" else {
+                "source_generation": src.name,
+                "target_generation": dst.name,
+                "hbm_bw_scale": round(dst.hbm_bw_gbs / src.hbm_bw_gbs, 3),
+                "bf16_tflops_scale": round(dst.bf16_tflops / src.bf16_tflops, 3),
+            }
+            suffix = f"{gen_name}-{chips}{'-int8' if wbytes == 1.0 else ''}"
+            doc = build_profile_json(
+                gen_raw, suffix, n_chips=chips,
+                hbm_per_chip_gb=dst.hbm_per_chip_gb,
+                weight_bytes_per_param=wbytes,
+                ici_bw_gbs=dst.ici_bw_gbs,
+                ici_latency_us=dst.ici_latency_us,
+                cross_generation=cross_gen,
+                cross_model=cm_meta,
+            )
+            if doc["maxBatchSize"] <= 0:
+                continue  # memory-infeasible shape (e.g. bf16 never fits)
+            outputs[f"{model}_{suffix}.json"] = doc
+    return outputs
+
+
 def discover_models() -> list[str]:
     names = set()
     for p in RAW_DIR.glob("*_tpu.json"):
@@ -153,10 +217,14 @@ def discover_models() -> list[str]:
 
 
 def main() -> None:
-    models = sys.argv[1:] or discover_models()
+    measured = discover_models()
+    models = sys.argv[1:] or sorted(set(measured) | set(CROSS_MODEL))
     for model in models:
         try:
-            built = build_model(model)
+            if model in CROSS_MODEL and model not in measured:
+                built = build_cross_model(model)
+            else:
+                built = build_model(model)
         except UnfittableRawError as e:
             # an in-progress sweep (single layer depth so far) must not
             # abort regeneration of every other model's profiles; any
