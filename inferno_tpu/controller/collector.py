@@ -22,6 +22,7 @@ from inferno_tpu.controller.crd import (
     VariantAutoscaling,
 )
 from inferno_tpu.controller.engines import (
+    GATEWAY_MODEL_LABEL,
     LABEL_NAMESPACE,
     EngineMetrics,
 )
@@ -153,6 +154,62 @@ def _observed_max_batch(
         if prof.acc == accelerator and prof.max_batch_size > 0:
             return prof.max_batch_size
     return DEFAULT_MAX_BATCH
+
+
+def collect_sleeping_alloc(
+    prom: PromClient,
+    engine: EngineMetrics,
+    va: VariantAutoscaling,
+    workload,
+) -> CurrentAlloc:
+    """CurrentAlloc for a variant scaled to ZERO replicas
+    (WVA_SCALE_TO_ZERO): every engine series died with the pods, so the
+    only live demand signal is the gateway-side request counter
+    (engine.gateway_request_total — e.g. the llm-d inference-gateway's
+    per-model series, which exist independently of engine pods). The load
+    SHAPE (avg in/out tokens) is reused from the last observed cycle
+    persisted in CR status — no token telemetry exists while asleep, and
+    the profile-anchor default (128/128) covers a variant that never ran.
+
+    This is the metric-series stranding mitigation: without it, a
+    scaled-to-zero variant is skipped as MetricsMissing forever (stale
+    desired gauge, KEDA fallback firing), and demand can never wake it.
+    Raises PromError on query failure like collect_current_alloc."""
+    ns = workload.namespace or va.namespace
+    model = va.spec.model_id
+    arrival = 0.0
+    if engine.gateway_request_total:
+        # the gateway names models with ITS label convention
+        # (GATEWAY_MODEL_LABEL), never the engine's — a JetStream
+        # variant's wake query must not filter on `id`
+        sel = f'{{{GATEWAY_MODEL_LABEL}="{model}",{LABEL_NAMESPACE}="{ns}"}}'
+        samples = prom.query(
+            f"sum(rate({engine.gateway_request_total}{sel}[1m]))"
+        )
+        if not samples:
+            sel = f'{{{GATEWAY_MODEL_LABEL}="{model}"}}'
+            samples = prom.query(
+                f"sum(rate({engine.gateway_request_total}{sel}[1m]))"
+            )
+        arrival = _first_value(samples) * 60.0  # req/sec -> req/min
+    last = va.status.current_alloc.load
+    accelerator = va.labels.get(ACCELERATOR_LABEL, "")
+    return CurrentAlloc(
+        accelerator=accelerator,
+        num_replicas=0,
+        max_batch=_observed_max_batch(prom, engine, model, ns, va, accelerator),
+        variant_cost=0.0,
+        itl_average=0.0,
+        ttft_average=0.0,
+        load=LoadProfile(
+            arrival_rate=arrival,
+            # 128/128 fallback = the profile-calibration anchor shape
+            # (models/profiles.TTFT_ANCHOR_TOKENS; not imported — that
+            # module pulls numpy into this otherwise-stdlib path)
+            avg_input_tokens=last.avg_input_tokens or 128.0,
+            avg_output_tokens=last.avg_output_tokens or 128.0,
+        ),
+    )
 
 
 def collect_current_alloc(

@@ -13,6 +13,12 @@ import dataclasses
 
 LABEL_MODEL_NAME = "model_name"
 LABEL_NAMESPACE = "namespace"
+# The gateway's model label is FIXED, not the engine's: the gateway
+# series (gateway_request_total below) live on the inference gateway,
+# which names models with the Gateway API inference extension's
+# `model_name` label no matter which engine serves them — resolving it
+# through engine.model_label would break JetStream (`id`) wake queries.
+GATEWAY_MODEL_LABEL = LABEL_MODEL_NAME
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,6 +41,15 @@ class EngineMetrics:
     # the collector prefers the live engine value, then the CR profile)
     max_batch_metric: str = ""
     model_label: str = LABEL_MODEL_NAME
+    # Gateway-side request counter whose series exist INDEPENDENTLY of
+    # engine pods — the scale-from-zero wake signal (docs/integrations/
+    # keda.md): with WVA_SCALE_TO_ZERO and a variant at 0 replicas, every
+    # engine series above is gone with the pods, so demand can only be
+    # observed upstream. Default: the Gateway API inference extension /
+    # llm-d inference-gateway per-model counter. "" disables the wake
+    # signal (a sleeping variant then stays at 0 until the series name is
+    # configured).
+    gateway_request_total: str = "inference_model_request_total"
 
 
 VLLM_TPU = EngineMetrics(

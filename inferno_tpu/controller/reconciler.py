@@ -37,10 +37,12 @@ from inferno_tpu.config.types import (
 from inferno_tpu.controller.actuator import Actuator
 from inferno_tpu.controller.collector import (
     collect_current_alloc,
+    collect_sleeping_alloc,
     validate_metrics_availability,
 )
 from inferno_tpu.controller.crd import (
     GROUP,
+    REASON_METRICS_MISSING,
     REASON_METRICS_UNAVAILABLE,
     REASON_OPTIMIZATION_FAILED,
     REASON_OPTIMIZATION_SUCCEEDED,
@@ -443,13 +445,33 @@ class Reconciler:
         validation = validate_metrics_availability(
             self.prom, engine, va.spec.model_id, va.namespace
         )
+        # Scaled-to-zero is ASLEEP, not broken (the metric-series
+        # stranding hazard): at 0 replicas every engine series died with
+        # the pods, so MetricsMissing is the EXPECTED state — skipping
+        # would freeze the desired gauge forever and demand could never
+        # wake the variant. Only the exact combination qualifies: the
+        # feature enabled, series missing (not stale, not a Prometheus
+        # error), and the workload truly at zero.
+        # SPEC replicas, not readiness: intent is what distinguishes
+        # asleep from broken — a workload WANTING pods (spec > 0) whose
+        # pods are crash-looping with no metrics is MetricsMissing
+        # breakage and must be skipped, never optimized down to zero
+        asleep = (
+            not validation.available
+            and self.config.scale_to_zero
+            and validation.reason == REASON_METRICS_MISSING
+            and wl.replicas == 0
+        )
         va.status.set_condition(
             TYPE_METRICS_AVAILABLE,
             "True" if validation.available else "False",
             validation.reason,
-            validation.message,
+            validation.message + (
+                " Variant is scaled to zero; optimizing from gateway demand."
+                if asleep else ""
+            ),
         )
-        if not validation.available:
+        if not validation.available and not asleep:
             va.status.set_condition(
                 TYPE_OPTIMIZATION_READY,
                 "False",
@@ -474,7 +496,10 @@ class Reconciler:
         if prof is not None:
             cost *= prof.acc_count * (prof.disagg.slices_per_unit if prof.disagg else 1)
         try:
-            current = collect_current_alloc(self.prom, engine, va, wl, cost)
+            if asleep:
+                current = collect_sleeping_alloc(self.prom, engine, va, wl)
+            else:
+                current = collect_current_alloc(self.prom, engine, va, wl, cost)
         except PromError as e:
             report.errors.append(f"{va.full_name}: collect: {e}")
             return False
@@ -487,7 +512,9 @@ class Reconciler:
         # direct telemetry)
         corr_key = ""
         corr_decode = corr_prefill = corr_state = None
-        if self.corrector is not None:
+        # no latency telemetry exists while asleep: a zeroed observation
+        # would corrupt the running correction state
+        if self.corrector is not None and not asleep:
             from inferno_tpu.models.corrector import Observation
 
             acc_now = current.accelerator or matching_profiles[0].acc

@@ -18,6 +18,8 @@ inferno_tpu.controller.collector):
 * `sum(rate(NAME{sel}[1m]))`                      -> windowed counter rate
 * `sum(rate(A{sel}[1m]))/sum(rate(B{sel}[1m]))`   -> ratio of rates
 * `NAME{sel}`                                     -> latest instant vector
+* `max(NAME{sel}) by (a, b)`                      -> the prometheus-adapter
+  sample rules' metricsQuery shape (testing/hpa.ExternalMetricsAdapter)
 * `up`                                            -> 1 per scrape target
 
 The `[1m]` literal is cosmetic: the evaluation window is the
@@ -67,6 +69,7 @@ def _parse_vector_selector(expr: str):
 
 
 _RATE = re.compile(r"sum\(rate\(([^\[]+)\[[^\]]*\]\)\)")
+_MAX_BY = re.compile(r"^max\(([^)]+)\)\s*by\s*\(([^)]*)\)$")
 
 
 class MiniProm:
@@ -123,6 +126,16 @@ class MiniProm:
         with self.lock:
             self.targets.append((url, labels or {}))
 
+    def remove_target(self, target) -> None:
+        """Drop a target AND its series history — the compressed-time
+        analogue of Prometheus staleness handling when the scraped pods
+        are gone (a scaled-to-zero engine's series stop resolving ~5 min
+        after the last scrape; tests can't wait that long)."""
+        with self.lock:
+            self.targets = [(t, ex) for t, ex in self.targets if t != target]
+            for key in [k for k in self.history if k[0] == target]:
+                del self.history[key]
+
     def scrape_once(self) -> None:
         with self.lock:
             targets = list(self.targets)
@@ -143,11 +156,22 @@ class MiniProm:
                     continue
             series = parse_exposition(text)
             with self.lock:
+                seen = set()
                 for name, labels, value in series:
                     # series-native labels win over target labels
                     merged = {**extra, **labels}
                     key = (target, name, tuple(sorted(merged.items())))
+                    seen.add(key)
                     self.history.setdefault(key, deque(maxlen=512)).append((now, value))
+                # Staleness markers, like real Prometheus: a series that
+                # disappears from a successful scrape (a pruned gauge, a
+                # re-keyed label set) is tombstoned so instant queries stop
+                # returning its last value immediately — without this, a
+                # variant's old accelerator-labelled gauges would answer
+                # KEDA/adapter queries forever.
+                for key, hist in self.history.items():
+                    if key[0] == target and key not in seen and hist[-1][1] is not None:
+                        hist.append((now, None))
 
     def _scrape_loop(self) -> None:
         while not self._stop.is_set():
@@ -166,16 +190,20 @@ class MiniProm:
     # -- evaluation ----------------------------------------------------------
 
     def _matching(self, name: str, matchers: dict):
-        """All series histories matching name + label equality matchers."""
+        """All LIVE series histories matching name + label equality
+        matchers (tombstoned series — last sample None — are stale and
+        excluded; rate windows filter the markers out per-point)."""
         with self.lock:
             items = list(self.history.items())
         out = []
         for (target, sname, labels_key), hist in items:
             if sname != name:
                 continue
+            if hist and hist[-1][1] is None:
+                continue  # stale: vanished from its target's last scrape
             labels = dict(labels_key)
             if all(labels.get(k) == v for k, v in matchers.items()):
-                out.append((labels, list(hist)))
+                out.append((labels, [(t, v) for t, v in hist if v is not None]))
         return out
 
     def _rate(self, name: str, matchers: dict) -> float:
@@ -216,6 +244,28 @@ class MiniProm:
                      "value": [now, "1"]}
                     for t, _ in targets
                 ]
+            )
+
+        # `max(NAME{sel}) by (a, b)` — the exact metricsQuery shape the
+        # prometheus-adapter sample rules emit for the actuation gauges
+        # (deploy/samples/prometheus-adapter-values.yaml); max() keeps the
+        # value stable if two controller replicas briefly emit during a
+        # leader transition
+        m = _MAX_BY.match(query)
+        if m:
+            name, matchers = _parse_vector_selector(m.group(1))
+            by = tuple(k.strip() for k in m.group(2).split(",") if k.strip())
+            grouped: dict[tuple, float] = {}
+            labels_by_key: dict[tuple, dict] = {}
+            for labels, hist in self._matching(name, matchers):
+                key = tuple(labels.get(k, "") for k in by)
+                _, v = hist[-1]
+                if key not in grouped or v > grouped[key]:
+                    grouped[key] = v
+                labels_by_key[key] = {k: labels.get(k, "") for k in by}
+            return vector(
+                [{"metric": labels_by_key[k], "value": [time.time(), str(v)]}
+                 for k, v in sorted(grouped.items())]
             )
 
         rates = _RATE.findall(query)

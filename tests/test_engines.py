@@ -30,16 +30,20 @@ def test_all_series_names_populated(engine):
 
 
 def test_vocabularies_do_not_overlap():
-    """A scrape carrying both engines' series must never alias: no series
-    name may appear in both vocabularies."""
+    """A scrape carrying both engines' series must never alias: no
+    ENGINE-side series name may appear in both vocabularies. The
+    gateway_request_total series is deliberately shared — it lives on the
+    inference gateway, upstream of (and independent from) any engine."""
     def series(e):
         return {
             getattr(e, f.name)
             for f in dataclasses.fields(e)
-            if f.name not in ("name", "model_label") and getattr(e, f.name)
+            if f.name not in ("name", "model_label", "gateway_request_total")
+            and getattr(e, f.name)
         }
 
     assert series(VLLM_TPU).isdisjoint(series(JETSTREAM))
+    assert VLLM_TPU.gateway_request_total == JETSTREAM.gateway_request_total
 
 
 def test_vllm_names_match_reference_constants():

@@ -179,6 +179,31 @@ def test_up_lists_targets():
     assert all(r["value"][1] == "1" for r in resp["data"]["result"])
 
 
+def test_max_by_groups_and_takes_max():
+    """The prometheus-adapter sample rules' metricsQuery shape: max()
+    over duplicate series (two controller replicas during a leader
+    transition), grouped by the adapter's override labels."""
+    prom = mk([lambda: expo([
+        'inferno_desired_replicas{variant_name="a",namespace="ns",pod="p1"} 3',
+        'inferno_desired_replicas{variant_name="a",namespace="ns",pod="p2"} 5',
+        'inferno_desired_replicas{variant_name="b",namespace="ns",pod="p1"} 2',
+    ])])
+    prom.scrape_once()
+    resp = prom.evaluate(
+        'max(inferno_desired_replicas{namespace="ns"}) '
+        'by (variant_name, namespace)')
+    rows = {r["metric"]["variant_name"]: float(r["value"][1])
+            for r in resp["data"]["result"]}
+    assert rows == {"a": 5.0, "b": 2.0}
+    assert all(set(r["metric"]) == {"variant_name", "namespace"}
+               for r in resp["data"]["result"])
+    # selector narrows before grouping
+    resp = prom.evaluate(
+        'max(inferno_desired_replicas{variant_name="b",namespace="ns"}) '
+        'by (variant_name, namespace)')
+    assert result_values(resp) == [2.0]
+
+
 def test_in_process_client_round_trip():
     prom = mk([lambda: expo(['m{a="1"} 2.5'])])
     prom.scrape_once()
