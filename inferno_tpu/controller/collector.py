@@ -179,18 +179,18 @@ def collect_sleeping_alloc(
     model = va.spec.model_id
     arrival = 0.0
     if engine.gateway_request_total:
-        # the gateway names models with ITS label convention
+        # The gateway names models with ITS label convention
         # (GATEWAY_MODEL_LABEL), never the engine's — a JetStream
-        # variant's wake query must not filter on `id`
+        # variant's wake query must not filter on `id`. NO namespace-less
+        # fallback here (unlike validate_metrics_availability's
+        # presence probe): this value feeds the optimizer directly, and
+        # a fallback would let another namespace's traffic for the same
+        # model wake — and keep re-provisioning — a variant with zero
+        # real demand (review r5).
         sel = f'{{{GATEWAY_MODEL_LABEL}="{model}",{LABEL_NAMESPACE}="{ns}"}}'
         samples = prom.query(
             f"sum(rate({engine.gateway_request_total}{sel}[1m]))"
         )
-        if not samples:
-            sel = f'{{{GATEWAY_MODEL_LABEL}="{model}"}}'
-            samples = prom.query(
-                f"sum(rate({engine.gateway_request_total}{sel}[1m]))"
-            )
         arrival = _first_value(samples) * 60.0  # req/sec -> req/min
     last = va.status.current_alloc.load
     accelerator = va.labels.get(ACCELERATOR_LABEL, "")

@@ -17,6 +17,7 @@ tests can check the whole stack against analytic expectations. A
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import threading
 import time
 from collections import deque
@@ -50,7 +51,9 @@ class RequestResult:
     latency_emu_ms: float = 0.0
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)  # identity semantics: requests are
+# unique in-flight objects; field-wise __eq__ would make every
+# list-removal a deep comparison scan (and Events don't compare anyway)
 class _Request:
     in_tokens: int
     out_tokens: int
@@ -63,6 +66,10 @@ class _Request:
     finished_emu: float = 0.0
     tokens_done: int = 0
     prefilled: bool = False
+    # iteration count at admission (aggregated engine only): progress is
+    # derived as step_index - admit_step instead of per-step increments,
+    # so one decode iteration costs O(1) bookkeeping, not O(batch)
+    admit_step: int = 0
     # a request whose KV footprint can NEVER fit the engine (in + out >
     # capacity even on an empty engine) is rejected at submit instead of
     # head-of-line-blocking the admission queue forever (real engines
@@ -101,9 +108,22 @@ class EmulatedEngine:
         self.profile = profile
         self.time_scale = time_scale
         self.waiting: deque[_Request] = deque()
-        self.running: list[_Request] = []
+        # keyed by id(request): completion removal must be O(1), not a
+        # list scan — at SLO-sized batches roughly one request completes
+        # per iteration, so a scan would re-tax every step by O(batch)
+        self.running: dict[int, _Request] = {}
         self.lock = threading.Lock()
         self.stop_flag = False
+        # event-driven completion tracking: per iteration the loop does
+        # O(1) work plus O(1) amortized per request (admission + the one
+        # heap pop at completion) — per-step scans over the whole batch
+        # made large operating points (B ~ 200+) physically unemulable,
+        # the loop overhead outweighing the modeled step time
+        self._step_index = 0
+        self._new: list[_Request] = []  # admitted, awaiting their prefill step
+        self._finish_heap: list[tuple[int, int, _Request]] = []
+        self._heap_seq = 0
+        self._kv_reserved = 0  # in+out reservations of running requests
         # telemetry event windows (timestamp, payload) for the fake scrape
         self.arrivals: deque[float] = deque(maxlen=100_000)
         self.completions: deque[tuple[float, RequestResult]] = deque(maxlen=100_000)
@@ -163,15 +183,23 @@ class EmulatedEngine:
         """Fraction of KV capacity in ACTUAL use (in + generated-so-far)
         — a telemetry gauge, deliberately not the reservation sum that
         `_admit` gates on; with reservation-based admission it can never
-        exceed 1.0."""
+        exceed 1.0. Progress derives from the iteration counter (one
+        token per iteration since admission) — O(batch), but only when
+        the gauge is read, never per decode step."""
         with self.lock:
-            used = sum(r.in_tokens + r.tokens_done for r in self.running)
+            used = sum(
+                r.in_tokens + min(max(self._step_index - r.admit_step, 0),
+                                  r.out_tokens)
+                for r in self.running.values()
+            )
         return min(used / self.profile.kv_tokens_capacity, 1.0)
 
     # -- decode loop --------------------------------------------------------
 
     def _admit(self) -> None:
         with self.lock:
+            if not self.waiting:
+                return
             # An idle engine serves an arrival immediately in the modeled
             # (discrete-event) world; any gap between arrival and this
             # admission poll is host artifact, so restart its virtual
@@ -179,20 +207,30 @@ class EmulatedEngine:
             # stamps — waiting out the in-flight step is real queueing.
             was_idle = not self.running
             # Reservation-based admission (r4 advisor): every running
-            # request reserves its FULL in+out footprint, matching the
-            # candidate's accounting — otherwise aggregate KV can exceed
-            # capacity later as admitted requests generate tokens (this
-            # emulator has no preemption to recover with).
-            kv_used = sum(r.in_tokens + r.out_tokens for r in self.running)
+            # request reserves its FULL in+out footprint — held as the
+            # incremental self._kv_reserved, never recomputed per step —
+            # matching the candidate's accounting; otherwise aggregate KV
+            # can exceed capacity later as admitted requests generate
+            # tokens (this emulator has no preemption to recover with).
             while self.waiting and len(self.running) < self.profile.max_batch:
                 nxt = self.waiting[0]
-                if kv_used + nxt.in_tokens + nxt.out_tokens > self.profile.kv_tokens_capacity:
+                footprint = nxt.in_tokens + nxt.out_tokens
+                if self._kv_reserved + footprint > self.profile.kv_tokens_capacity:
                     break  # KV admission control (vllm_model.py:254-467)
                 self.waiting.popleft()
                 if was_idle:
                     nxt.arrived_emu = max(nxt.arrived_emu, self.emu_ms)
-                self.running.append(nxt)
-                kv_used += nxt.in_tokens + nxt.out_tokens
+                nxt.admit_step = self._step_index
+                self.running[id(nxt)] = nxt
+                self._new.append(nxt)
+                self._kv_reserved += footprint
+                # one token per iteration starting with the next one:
+                # finished after out_tokens iterations
+                self._heap_seq += 1
+                heapq.heappush(
+                    self._finish_heap,
+                    (self._step_index + nxt.out_tokens, self._heap_seq, nxt),
+                )
 
     def _loop(self) -> None:
         p = self.profile
@@ -200,7 +238,8 @@ class EmulatedEngine:
             self._admit()
             with self.lock:
                 batch = len(self.running)
-                new = [r for r in self.running if not r.prefilled]
+                new = self._new
+                self._new = []
             if batch == 0:
                 # idle: keep the virtual clock tracking wall time so
                 # arrival timestamps stay meaningful across quiet gaps
@@ -210,30 +249,46 @@ class EmulatedEngine:
                     self.emu_ms += (time.time() - t0) * 1000.0 / max(self.time_scale, 1e-9)
                     self._last_tick_wall = time.time()
                 continue
-            # one iteration: prefill for newly admitted + one decode step
+            # One iteration: a decode step, plus the newly admitted
+            # requests' prefill chunks riding it. The chunk SHARES the
+            # iteration's weight pass (the architecture the on-chip mixed
+            # kernel measures — llama_block.make_mixed_fn: projections
+            # computed once for decode rows + chunk), so its marginal
+            # cost is the per-token slope delta times the chunk tokens.
+            # gamma (the fixed prefill cost, dominated by the weight
+            # read) is charged only when there is NO decode iteration to
+            # share with (engine idle -> pure prefill iteration). The
+            # previous surcharge gamma + delta*in*batch misread the
+            # TTFT-vs-B SIZING form as a physical per-chunk cost and
+            # triple-counted prefill interference at high occupancy,
+            # making SLO-sized operating points (B ~ 200+) falsely
+            # unstable under emulation.
             step_ms = p.alpha + p.beta * batch + p.beta2 * batch * batch
             if new:
-                in_toks = max(r.in_tokens for r in new)
-                step_ms += p.gamma + p.delta * in_toks * batch
+                step_ms += p.delta * sum(r.in_tokens for r in new)
+                if len(new) == batch:  # no in-flight decode to share
+                    step_ms += p.gamma
             time.sleep(step_ms / 1000.0 * self.time_scale)
             now = time.time()
             finished: list[_Request] = []
             with self.lock:
                 self.emu_ms += step_ms
                 self._last_tick_wall = now
+                self._step_index += 1
                 emu_now = self.emu_ms
-                for r in self.running:
-                    if not r.prefilled:
-                        r.prefilled = True
-                        r.first_token_at = now
-                        r.first_token_emu = max(emu_now, r.arrived_emu)
-                    r.tokens_done += 1
-                    if r.tokens_done >= r.out_tokens:
-                        r.finished_at = now
-                        r.finished_emu = max(emu_now, r.first_token_emu)
-                        finished.append(r)
-                for r in finished:
-                    self.running.remove(r)
+                for r in new:
+                    r.prefilled = True
+                    r.first_token_at = now
+                    r.first_token_emu = max(emu_now, r.arrived_emu)
+                heap = self._finish_heap
+                while heap and heap[0][0] <= self._step_index:
+                    _, _, r = heapq.heappop(heap)
+                    r.tokens_done = r.out_tokens
+                    r.finished_at = now
+                    r.finished_emu = max(emu_now, r.first_token_emu)
+                    finished.append(r)
+                    del self.running[id(r)]
+                    self._kv_reserved -= r.in_tokens + r.out_tokens
                     self.completions.append(
                         (
                             now,

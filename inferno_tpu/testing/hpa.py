@@ -124,7 +124,10 @@ class KedaScaledObject:
         active = metric > self.activation_threshold
         if active:
             self._last_active = t
-            desired = max(1, math.ceil(metric / self.threshold))
+            # real KEDA writes minReplicaCount into the generated HPA's
+            # minReplicas, so the active-path floor is max(1, min_count)
+            desired = max(1, self.min_replica_count,
+                          math.ceil(metric / self.threshold))
             desired = min(self.max_replica_count, desired)
         else:
             # deactivation: scale to minReplicaCount only after the

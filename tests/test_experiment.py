@@ -118,7 +118,10 @@ def test_disagg_scenario_reports_tandem_model():
         name="disagg-test",
         rate=RateSpec(((2.0, 8.0),)),
         out_tokens=16,
-        time_scale=0.05,
+        # 0.2, not smaller: the disagg virtual clock divides wall time,
+        # so a 20 ms step must wall-sleep >= ~4 ms for host scheduling
+        # noise to stay inside the model_error bound on a loaded box
+        time_scale=0.2,
         disagg=DisaggProfile(alpha=20.0, beta=0.4, gamma=5.0, delta=0.02,
                              prefill_max_batch=8, decode_max_batch=64,
                              prefill_engines=1, decode_engines=2,
