@@ -537,6 +537,25 @@ def fleet_cycle_metrics(full: bool = True) -> dict:
         out["lanes_512"]["native_ms"] = round(native_ms, 3)
         out["lanes_512"]["vs_native"] = round(native_ms / tpu_ms, 3)
 
+    if platform == "tpu":
+        # ON-CHIP extras (round-4 verdict weak #2: the Pallas kernel's
+        # whole point is VMEM fusion, and it had no on-chip timing in any
+        # driver artifact — capture one whenever the chip is reachable)
+        def pallas_step(system):
+            calculate_fleet(system, backend="tpu-pallas")
+            optimize(system, opt)
+
+        try:
+            pallas_step(System(spec))  # compile outside the timer
+            out["lanes_512"]["pallas_ms"] = round(
+                time_cycles(pallas_step, spec, 5), 3)
+            out["lanes_512"]["pallas_vs_xla"] = round(
+                tpu_ms / out["lanes_512"]["pallas_ms"], 3)
+        except Exception as exc:  # a pallas lowering regression must not
+            # cost the whole bench artifact
+            out["lanes_512"]["pallas_error"] = str(exc)[:200]
+        out["profile_drift"] = _profile_drift_check()
+
     if full:
         # lane scaling: the batched path's advantage grows with fleet size
         # (skipped with --quick: the 4096-lane scalar pass dominates CI time)
@@ -550,6 +569,70 @@ def fleet_cycle_metrics(full: bool = True) -> dict:
             "vs_scalar": round(scalar_4k_ms / tpu_4k_ms, 3),
         }
     return out
+
+
+def _profile_drift_check() -> dict:
+    """Re-measure ONE committed raw point on the reachable chip (decode,
+    L=2, B=8 int8 — seconds, not a full campaign) and report the drift
+    against the committed measurement, so every on-TPU bench run doubles
+    as a staleness canary for the profile store (round-4 verdict #5)."""
+    import jax
+
+    from inferno_tpu.models.llama_block import (
+        MODEL_PRESETS,
+        init_stack,
+        make_decode_fn,
+    )
+    from inferno_tpu.models.profiles import PROFILES_DIR
+
+    raw_path = PROFILES_DIR / "raw" / "llama-3.1-8b_tpu_int8.json"
+    try:
+        raw = json.loads(raw_path.read_text())
+        committed = next(
+            s["step_ms"] for s in raw["decode"]
+            if s["n_layers"] == 2 and s["batch"] == 8
+        )
+    except Exception as exc:  # corrupt/truncated raw must degrade to an
+        # error record too, not crash the bench before its artifact exists
+        return {"error": f"no committed L=2/B=8 int8 decode point: {exc}"}
+    try:
+        dims = MODEL_PRESETS["llama-3.1-8b"]
+        # EXACTLY the profiler's configuration for this point
+        # (tools/profile_tpu.py: s_max = context + steps, start at
+        # context) — a different cache size would measure a different
+        # attention read volume and report phantom drift
+        ctx = int(raw["meta"].get("decode_context", 1024))
+        steps = int(raw["meta"].get("decode_steps_per_call", 64))
+        n_layers, batch = 2, 8
+        s_max = ctx + steps
+        params = init_stack(jax.random.PRNGKey(2), dims, n_layers, "int8")
+        import jax.numpy as jnp
+
+        caches = tuple(
+            jnp.zeros((batch, dims.n_kv_heads, s_max, dims.head_dim),
+                      dtype=jnp.bfloat16)
+            for _ in range(2 * n_layers)
+        )
+        x0 = jnp.zeros((batch, 1, dims.hidden), dtype=jnp.bfloat16)
+        decode = make_decode_fn(dims, n_layers, steps)
+        rtt = _device_roundtrip_ms()
+        float(decode(params, x0, caches, ctx)[0])  # compile + warm
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(decode(params, x0, caches, ctx)[0])
+            samples.append(
+                ((time.perf_counter() - t0) * 1000.0 - rtt) / steps)
+        measured = statistics.median(samples)
+        return {
+            "point": {"sweep": "decode", "n_layers": 2, "batch": 8,
+                      "dtype": "int8"},
+            "committed_step_ms": round(committed, 4),
+            "measured_step_ms": round(measured, 4),
+            "drift_rel": round(abs(measured - committed) / committed, 4),
+        }
+    except Exception as exc:
+        return {"error": f"on-chip drift measurement failed: {str(exc)[:200]}"}
 
 
 def _pin_cpu_if_tpu_unreachable(timeout_s: float = 120.0) -> dict:

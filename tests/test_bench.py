@@ -142,6 +142,20 @@ def test_llama_70b_multihost_table(ns):
     assert full["llama_70b"]["per_shape_usd_per_mtok"] == table
 
 
+def test_profile_drift_check_never_raises():
+    """The on-TPU drift canary runs inside every reachable-chip bench; a
+    failure (here: CPU lacks the bf16 dot) must degrade to an error
+    record, never cost the bench artifact. On a TPU it returns the
+    committed-vs-measured step time for the pinned raw point."""
+    r = bench._profile_drift_check()
+    assert isinstance(r, dict)
+    assert ("drift_rel" in r) != ("error" in r)  # exactly one outcome
+    if "drift_rel" in r:
+        assert r["point"] == {"sweep": "decode", "n_layers": 2, "batch": 8,
+                              "dtype": "int8"}
+        assert r["committed_step_ms"] > 0 and r["measured_step_ms"] > 0
+
+
 def test_north_star_is_strict_json(ns):
     # the bench output contract: one RFC-8259 line; Infinity/NaN would
     # break jq / Go / JSON.parse consumers (review r4)

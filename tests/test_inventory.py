@@ -122,3 +122,64 @@ def test_unschedulable_and_malformed_nodes_skipped():
     cap = collect_tpu_inventory(K())
     assert cap.chips == {"v5e": 8}
     assert node_tpu_chips({"status": {"allocatable": {"google.com/tpu": None}}}) == 0
+
+
+def test_limited_mode_over_real_http_apiserver():
+    """The kind CI job's limited-mode variant, rehearsed offline over the
+    wire: Node objects with fake google.com/tpu capacity live behind the
+    real-HTTP MiniApiServer, OPTIMIZER_MODE=limited with NO static
+    TPU_CAPACITY, and the greedy solver's decision is capped by the
+    DISCOVERED pool (2 nodes x 4 chips = 8 -> two v5e-4 pod-slices)."""
+    import json as _json
+    import urllib.request
+
+    from inferno_tpu.controller.kube import RestKubeClient
+    from inferno_tpu.testing.apiserver import MiniApiServer
+
+    from test_apiserver import add_deployment, make_va_doc, post, seed_config
+
+    srv = MiniApiServer().start()
+    try:
+        seed_config(srv)
+        # limited mode, no TPU_CAPACITY: inventory is the only source
+        cm_path = f"/api/v1/namespaces/{CFG_NS}/configmaps/inferno-autoscaler-config"
+        cur = _json.loads(urllib.request.urlopen(srv.url + cm_path).read())
+        cur["data"].update({"OPTIMIZER_MODE": "limited",
+                            "SATURATION_POLICY": "PriorityExhaustive"})
+        req = urllib.request.Request(
+            srv.url + cm_path, method="PATCH",
+            data=_json.dumps({"data": cur["data"]}).encode(),
+            headers={"Content-Type": "application/merge-patch+json"})
+        urllib.request.urlopen(req)
+        for i in range(2):
+            post(srv, "/api/v1/nodes", {
+                "metadata": {
+                    "name": f"kind-worker-{i}",
+                    "labels": {"cloud.google.com/gke-tpu-accelerator":
+                               "tpu-v5-lite-podslice"},
+                },
+                "status": {"allocatable": {"google.com/tpu": "4"}},
+            })
+        post(srv, f"/apis/llmd.ai/v1alpha1/namespaces/{NS}/variantautoscalings",
+             make_va_doc())
+        add_deployment(srv, NS, "llama-premium", replicas=1)
+
+        client = RestKubeClient(base_url=srv.url, token="", namespace=CFG_NS)
+        rec = Reconciler(kube=client, prom=make_prom(arrival_rps=50.0),
+                         config=ReconcilerConfig(config_namespace=CFG_NS,
+                                                 compute_backend="scalar",
+                                                 direct_scale=True))
+        optimizer, capacity = rec.read_optimizer_and_capacity()
+        assert not optimizer.unlimited
+        assert capacity.chips == {"v5e": 8}
+
+        report = rec.run_cycle()
+        assert report.errors == [], report.errors
+        va = client.get_variant_autoscaling(NS, "llama-premium")
+        d = va.status.desired_optimized_alloc
+        # demand asks ~9-10 replicas (test_cycle_scales_out_under_load);
+        # 8 discovered chips cap v5e-4 at 2 pod-slices
+        assert d.accelerator == "v5e-4" and d.num_replicas == 2
+        assert client.get_deployment(NS, "llama-premium")["spec"]["replicas"] == 2
+    finally:
+        srv.stop()
