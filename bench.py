@@ -297,7 +297,7 @@ def north_star() -> dict:
     # same machinery at the same SLO/workload (no A100 baseline exists for
     # them in the reference; reported for breadth, not the headline)
     secondary = {}
-    for model in ("llama-3.2-3b", "llama-3.1-70b"):
+    for model in ("llama-3.2-3b", "llama-3.2-1b", "llama-3.1-70b"):
         shapes = size_model_shapes(model)
         by_shape = {a: round(v["usd_per_mtok"], 4) for a, v in shapes.items()}
         if by_shape:
@@ -578,11 +578,7 @@ def _profile_drift_check() -> dict:
     as a staleness canary for the profile store (round-4 verdict #5)."""
     import jax
 
-    from inferno_tpu.models.llama_block import (
-        MODEL_PRESETS,
-        init_stack,
-        make_decode_fn,
-    )
+    from inferno_tpu.models.llama_block import init_stack, make_decode_fn
     from inferno_tpu.models.profiles import PROFILES_DIR
 
     raw_path = PROFILES_DIR / "raw" / "llama-3.1-8b_tpu_int8.json"
@@ -596,7 +592,14 @@ def _profile_drift_check() -> dict:
         # error record too, not crash the bench before its artifact exists
         return {"error": f"no committed L=2/B=8 int8 decode point: {exc}"}
     try:
-        dims = MODEL_PRESETS["llama-3.1-8b"]
+        from inferno_tpu.models.llama_block import LlamaDims
+
+        # dims from the RAW FILE's recorded meta, not the live preset: a
+        # future preset edit must not make the canary report phantom
+        # drift against a measurement taken with the old dimensions
+        dims_in = dict(raw["meta"]["dims"])
+        dims_in.pop("n_layers_full", None)
+        dims = LlamaDims(**dims_in)
         # EXACTLY the profiler's configuration for this point
         # (tools/profile_tpu.py: s_max = context + steps, start at
         # context) — a different cache size would measure a different
@@ -621,9 +624,14 @@ def _profile_drift_check() -> dict:
         for _ in range(3):
             t0 = time.perf_counter()
             float(decode(params, x0, caches, ctx)[0])
+            # the profiler's convention: RTT subtracted, clamped at 0 —
+            # a noisy tunnel RTT sample must not yield a negative step
             samples.append(
-                ((time.perf_counter() - t0) * 1000.0 - rtt) / steps)
+                max((time.perf_counter() - t0) * 1000.0 - rtt, 0.0) / steps)
         measured = statistics.median(samples)
+        if measured <= 0:
+            return {"error": "measured step time not separable from the "
+                             "tunnel RTT; drift check inconclusive"}
         return {
             "point": {"sweep": "decode", "n_layers": 2, "batch": 8,
                       "dtype": "int8"},

@@ -98,6 +98,18 @@ def test_every_per_shape_row_has_provenance(ns):
     assert set(sec["per_shape_provenance"]) == set(sec["per_shape_usd_per_mtok"])
 
 
+def test_model_family_breadth(ns):
+    """The committed profile store spans the Llama family sizes the
+    reference's scenarios cover (1B/3B/8B/70B), each sized at the same
+    SLO; smaller models must serve strictly cheaper per token."""
+    sec = ns["secondary_models"]
+    assert {"llama-3.2-3b", "llama-3.2-1b", "llama-3.1-70b"} <= set(sec)
+    best_1b = min(sec["llama-3.2-1b"]["per_shape_usd_per_mtok"].values())
+    best_3b = min(sec["llama-3.2-3b"]["per_shape_usd_per_mtok"].values())
+    best_70b = min(sec["llama-3.1-70b"]["per_shape_usd_per_mtok"].values())
+    assert best_1b < best_3b < ns["tpu"]["usd_per_mtok"] < best_70b
+
+
 def test_measured_p99_meets_slo_at_benched_point(ns):
     """Round-4 verdict weak #4, closed: the p99 TTFT the headline
     promises is MEASURED by driving the emulator at the benched operating

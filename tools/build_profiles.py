@@ -58,6 +58,12 @@ CROSS_MODEL = {
         # config, v5p-16/v6e-16 the cross-generation economics rows
         "shapes": [("v5e", 16), ("v5p", 16), ("v6e", 16)],
     },
+    # small-model breadth: the 1B from the measured 3B sweep (same GQA
+    # family, head_dim 64 — the bytes/FLOPs rescale is dimension-exact)
+    "llama-3.2-1b": {
+        "from": "llama-3.2-3b",
+        "shapes": [("v5e", 1), ("v5e", 4), ("v6e", 4)],
+    },
 }
 
 
