@@ -592,14 +592,12 @@ def _profile_drift_check() -> dict:
         # error record too, not crash the bench before its artifact exists
         return {"error": f"no committed L=2/B=8 int8 decode point: {exc}"}
     try:
-        from inferno_tpu.models.llama_block import LlamaDims
+        from inferno_tpu.models.profiles import dims_from_meta
 
         # dims from the RAW FILE's recorded meta, not the live preset: a
         # future preset edit must not make the canary report phantom
         # drift against a measurement taken with the old dimensions
-        dims_in = dict(raw["meta"]["dims"])
-        dims_in.pop("n_layers_full", None)
-        dims = LlamaDims(**dims_in)
+        dims = dims_from_meta(raw["meta"]["dims"])
         # EXACTLY the profiler's configuration for this point
         # (tools/profile_tpu.py: s_max = context + steps, start at
         # context) — a different cache size would measure a different

@@ -1,0 +1,310 @@
+"""Real transformer compute for on-chip profiling: Gemma-2 architecture.
+
+The Llama block (`models/llama_block.py`) deliberately refuses to stand
+in for architectures with a different layer body (its MODEL_PRESETS
+note), because a profile measured on the wrong block is a wrong profile.
+Gemma-2 differs in every way that moves the roofline:
+
+* **sandwich norms** — RMSNorm BEFORE and AFTER each of attention and
+  MLP (4 norms/layer vs Llama's 2), with Gemma's (1 + w) weight
+  convention;
+* **GeGLU** — tanh-approximate GELU gating instead of SiLU;
+* **logit softcapping** — attention logits squashed to ±50 via
+  tanh (final LM logits to ±30), extra elementwise work XLA fuses into
+  the attention;
+* **alternating sliding-window attention** — even layers attend only to
+  the last `window` positions, odd layers globally (Gemma-2 technical
+  report); at long contexts this HALVES the KV read volume, which is
+  exactly the regime the context-bucketed profiles measure;
+* **query scaling** by `query_pre_attn_scalar**-0.5` (hidden/n_heads for
+  the 27B — NOT head_dim), and embedding scaling by sqrt(hidden).
+
+Same TPU-first structure and profiling API as the Llama block — stacked
+params, decode steps inside one `lax.fori_loop`, static shapes,
+head-major KV cache updated via `lax.dynamic_update_slice`, everything
+bfloat16 with float32 softmax/norm accumulation — so
+`tools/profile_tpu.py` drives either family through one code path.
+Reference for WHAT must be supported: the reference's model list covers
+Gemma-class dense models only through its generic linear profile
+(parameter-estimation.md measures vLLM from outside); here the compute
+is measured directly, so the block must be the real architecture.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmaDims:
+    """Gemma-2 model dimensions. Defaults are Gemma-2-9B."""
+
+    hidden: int = 3584
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    head_dim: int = 256
+    ffn: int = 14336
+    vocab: int = 256128
+    n_layers: int = 42
+    rope_theta: float = 10000.0
+    sliding_window: int = 4096
+    attn_softcap: float = 50.0
+    final_softcap: float = 30.0
+    # Gemma-2 scales queries by query_pre_attn_scalar**-0.5; the 27B sets
+    # it to hidden/n_heads, the 9B to head_dim
+    query_pre_attn_scalar: float = 256.0
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    def layer_params_bytes(self, dtype_bytes: int = 2) -> int:
+        attn = self.hidden * (self.q_dim + 2 * self.kv_dim) + self.q_dim * self.hidden
+        mlp = 3 * self.hidden * self.ffn
+        norms = 4 * self.hidden  # sandwich: pre+post for attn and mlp
+        return (attn + mlp + norms) * dtype_bytes
+
+    def kv_bytes_per_token(self, n_layers: int | None = None, dtype_bytes: int = 2) -> int:
+        layers = self.n_layers if n_layers is None else n_layers
+        return layers * 2 * self.kv_dim * dtype_bytes
+
+
+GEMMA_PRESETS: dict[str, GemmaDims] = {
+    "gemma-2-9b": GemmaDims(),
+    "gemma-2-27b": GemmaDims(hidden=4608, n_heads=32, n_kv_heads=16,
+                             head_dim=128, ffn=36864, vocab=256128,
+                             n_layers=46,
+                             query_pre_attn_scalar=4608 / 32),
+}
+
+
+def init_stack(
+    key: jax.Array, dims: GemmaDims, n_layers: int, weight_dtype: str = "bfloat16"
+) -> dict:
+    """Stacked parameters for `n_layers` Gemma-2 layers + final norm and
+    the (tied, read once per step) LM head. Same int8/float32 modes as
+    the Llama stack (w8a16 serving / CPU-testable)."""
+    ks = jax.random.split(key, 8)
+    h, q, kv, f = dims.hidden, dims.q_dim, dims.kv_dim, dims.ffn
+    scale = 0.02
+    bf = jnp.bfloat16
+
+    def w(k, shape):
+        full = jax.random.normal(k, shape, dtype=jnp.float32) * scale
+        if weight_dtype == "int8":
+            return jnp.clip(jnp.round(full / scale * 63.0), -127, 127).astype(jnp.int8)
+        if weight_dtype == "float32":
+            return full
+        return full.astype(bf)
+
+    # Gemma norm weights are stored as w with the (1 + w) convention;
+    # zeros reproduce identity-strength norms
+    layers = {
+        "wq": w(ks[0], (n_layers, h, q)),
+        "wk": w(ks[1], (n_layers, h, kv)),
+        "wv": w(ks[2], (n_layers, h, kv)),
+        "wo": w(ks[3], (n_layers, q, h)),
+        "w_gate": w(ks[4], (n_layers, h, f)),
+        "w_up": w(ks[5], (n_layers, h, f)),
+        "w_down": w(ks[6], (n_layers, f, h)),
+        "norm_attn_pre": jnp.zeros((n_layers, h), dtype=bf),
+        "norm_attn_post": jnp.zeros((n_layers, h), dtype=bf),
+        "norm_mlp_pre": jnp.zeros((n_layers, h), dtype=bf),
+        "norm_mlp_post": jnp.zeros((n_layers, h), dtype=bf),
+    }
+    return {
+        "layers": layers,
+        "norm_out": jnp.zeros((h,), dtype=bf),
+        "lm_head": w(ks[7], (h, dims.vocab)),
+    }
+
+
+def _rmsnorm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """Gemma convention: scale by (1 + w), norm in float32."""
+    xf = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * r * (1.0 + w.astype(jnp.float32))).astype(x.dtype)
+
+
+def _mm(x: jax.Array, w: jax.Array) -> jax.Array:
+    if w.dtype == jnp.int8:
+        w = w.astype(x.dtype)
+    return x @ w
+
+
+def _softcap(x: jax.Array, cap: float) -> jax.Array:
+    return jnp.tanh(x / cap) * cap
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    head_dim = x.shape[-1]
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., :, None].astype(jnp.float32) * freqs
+    cos = jnp.cos(angles)[..., :, None, :]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [(xf1 * cos - xf2 * sin).astype(x.dtype),
+         (xf2 * cos + xf1 * sin).astype(x.dtype)],
+        axis=-1,
+    )
+
+
+def _gqa_attend(q, k, v, mask, dims: GemmaDims):
+    """Grouped-query attention with Gemma's query scaling and attention
+    logit softcap. Shapes as in the Llama block (head-major cache)."""
+    b, tq = q.shape[0], q.shape[1]
+    groups = dims.n_heads // dims.n_kv_heads
+    qg = q.reshape(b, tq, dims.n_kv_heads, groups, dims.head_dim)
+    logits = jnp.einsum("bqhgd,bhkd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits * (dims.query_pre_attn_scalar ** -0.5)
+    logits = _softcap(logits, dims.attn_softcap) + mask[:, None, None, :, :]
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bhkd->bqhgd", probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype).reshape(b, tq, dims.q_dim)
+
+
+def _sliding_mask(base_mask: jax.Array, q_positions: jax.Array,
+                  k_positions: jax.Array, window: int) -> jax.Array:
+    """Restrict an additive causal mask to the last `window` positions:
+    key j visible to query i iff i - window < j <= i."""
+    delta = q_positions[..., :, None] - k_positions[..., None, :]
+    inside = delta < window
+    return jnp.where(inside, base_mask, -jnp.inf)
+
+
+def _layer(x, layer_p, kv_cache, positions, mask, dims: GemmaDims,
+           sliding: bool, k_positions):
+    """One Gemma-2 layer: sandwich-normed attention (sliding on even
+    layers) + sandwich-normed GeGLU MLP, KV cache write at `positions`."""
+    h = _rmsnorm(x, layer_p["norm_attn_pre"])
+    b, t = x.shape[0], x.shape[1]
+    q = _mm(h, layer_p["wq"]).reshape(b, t, dims.n_heads, dims.head_dim)
+    k = _mm(h, layer_p["wk"]).reshape(b, t, dims.n_kv_heads, dims.head_dim)
+    v = _mm(h, layer_p["wv"]).reshape(b, t, dims.n_kv_heads, dims.head_dim)
+    q = _rope(q, positions, dims.rope_theta)
+    k = _rope(k, positions, dims.rope_theta)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+
+    if kv_cache is not None:
+        start = positions[0, 0]
+        k_all = lax.dynamic_update_slice(kv_cache[0], k, (0, 0, start, 0))
+        v_all = lax.dynamic_update_slice(kv_cache[1], v, (0, 0, start, 0))
+        kv_cache = (k_all, v_all)
+    else:
+        k_all, v_all = k, v
+
+    attn_mask = (
+        _sliding_mask(mask, positions, k_positions, dims.sliding_window)
+        if sliding else mask
+    )
+    attn = _gqa_attend(q, k_all, v_all, attn_mask, dims)
+    x = x + _rmsnorm(_mm(attn, layer_p["wo"]), layer_p["norm_attn_post"])
+
+    h = _rmsnorm(x, layer_p["norm_mlp_pre"])
+    gated = jax.nn.gelu(_mm(h, layer_p["w_gate"]).astype(jnp.float32),
+                        approximate=True).astype(h.dtype)
+    mlp = _mm(gated * _mm(h, layer_p["w_up"]), layer_p["w_down"])
+    x = x + _rmsnorm(mlp, layer_p["norm_mlp_post"])
+    return x, kv_cache
+
+
+def make_decode_fn(dims: GemmaDims, n_layers: int, n_steps: int):
+    """Jittable multi-step decode, API-identical to
+    llama_block.make_decode_fn: (params, x0 (B,1,H), caches flat tuple,
+    start_pos) -> (scalar, x, caches). Even layer indices use the
+    sliding window (Gemma-2's alternating pattern)."""
+
+    def one_step(params, x, caches, pos):
+        b = x.shape[0]
+        s_max = caches[0].shape[2]
+        positions = jnp.broadcast_to(pos, (b, 1))
+        k_positions = jnp.broadcast_to(jnp.arange(s_max), (b, s_max))
+        valid = jnp.arange(s_max)[None, None, :] <= pos
+        mask = jnp.broadcast_to(
+            jnp.where(valid, 0.0, -jnp.inf).astype(jnp.float32), (b, 1, s_max)
+        )
+        new_caches = []
+        for li in range(n_layers):
+            layer_p = jax.tree.map(lambda t: t[li], params["layers"])
+            x, (k_c, v_c) = _layer(
+                x, layer_p, (caches[2 * li], caches[2 * li + 1]),
+                positions, mask, dims, sliding=(li % 2 == 0),
+                k_positions=k_positions,
+            )
+            new_caches.extend([k_c, v_c])
+        x = _rmsnorm(x, params["norm_out"])
+        logits = _softcap(
+            _mm(x[:, -1, :], params["lm_head"]).astype(jnp.float32),
+            dims.final_softcap,
+        )
+        nxt = jnp.tanh(logits[:, : dims.hidden]).astype(x.dtype)[:, None, :]
+        return nxt, tuple(new_caches), jnp.sum(logits)
+
+    def decode(params, x, caches, start_pos):
+        def body(i, carry):
+            x, caches, acc = carry
+            x, caches, s = one_step(params, x, caches, start_pos + i)
+            return (x, caches, acc + s)
+
+        x, caches, acc = lax.fori_loop(
+            0, n_steps, body, (x, caches, jnp.float32(0.0))
+        )
+        return acc + jnp.sum(x.astype(jnp.float32)), x, caches
+
+    return jax.jit(decode)
+
+
+def make_prefill_repeat_fn(dims: GemmaDims, reps: int):
+    """Jittable repeated causal prefill, API-identical to the Llama
+    version (scan over stacked layers, data-dependence across reps so
+    XLA cannot hoist the body)."""
+
+    def prefill_body(params, x):
+        b, t = x.shape[0], x.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+        causal = jnp.where(
+            jnp.arange(t)[:, None] >= jnp.arange(t)[None, :], 0.0, -jnp.inf
+        ).astype(jnp.float32)
+        mask = jnp.broadcast_to(causal, (b, t, t))
+        sliding = _sliding_mask(mask, positions, positions, dims.sliding_window)
+
+        def body(carry, inp):
+            layer_p, use_sliding = inp
+            # lax.scan needs one body: select the mask per layer parity
+            m = jnp.where(use_sliding, sliding, mask)
+            y, _ = _layer(carry, layer_p, None, positions, m, dims,
+                          sliding=False, k_positions=positions)
+            return y, None
+
+        parity = jnp.arange(
+            params["layers"]["wq"].shape[0]) % 2 == 0
+        y, _ = lax.scan(body, x, (params["layers"], parity))
+        y = _rmsnorm(y, params["norm_out"])
+        logits = _softcap(
+            _mm(y[:, -1, :], params["lm_head"]).astype(jnp.float32),
+            dims.final_softcap,
+        )
+        return jnp.sum(logits)
+
+    def repeated(params, x):
+        def body(i, acc):
+            s = prefill_body(params, x * (1.0 + acc * 1e-30).astype(x.dtype))
+            return acc + s * 1e-30
+
+        return lax.fori_loop(0, reps, body, jnp.float32(0.0))
+
+    return jax.jit(repeated)

@@ -51,6 +51,21 @@ class UnfittableRawError(ValueError):
     can skip it without masking real corruption."""
 
 
+def dims_from_meta(meta_dims: Mapping[str, Any]):
+    """Reconstruct the EXACT dims dataclass a raw sweep was measured
+    with: Gemma-2 raws (recognized by their family-specific fields)
+    become GemmaDims, everything else LlamaDims. Older raws carrying
+    only the Llama subset keep working (missing fields take defaults)."""
+    d = dict(meta_dims)
+    full = d.pop("n_layers_full")
+    d["n_layers"] = full
+    if "sliding_window" in d or "attn_softcap" in d:
+        from inferno_tpu.models.gemma_block import GemmaDims
+
+        return GemmaDims(**d)
+    return LlamaDims(**d)
+
+
 def _per_group_line_fits(
     samples: list[dict], key: str, group_keys: tuple[str, ...]
 ) -> dict[tuple, tuple[float, float, list[int], float]]:
@@ -411,9 +426,7 @@ def rescale_raw_cross_model(raw: Mapping[str, Any], dst_dims: LlamaDims,
     synthetic sweep, which is why consumers must mark it derived with
     `cross_model` assumptions). The profile pipeline then applies the
     destination model's own memory cap, TP derivation, and error bars."""
-    src_in = dict(raw["meta"]["dims"])
-    src_layers_full = src_in.pop("n_layers_full")
-    src = LlamaDims(**src_in, n_layers=src_layers_full)
+    src = dims_from_meta(raw["meta"]["dims"])
     # the profiler records the ACTIVATION dtype under meta.dtype (always
     # bfloat16) and the weight storage under meta.weight_dtype — the
     # decode traffic ratio must use the weight bytes (int8 sweeps move
@@ -453,16 +466,18 @@ def rescale_raw_cross_model(raw: Mapping[str, Any], dst_dims: LlamaDims,
                             key: c * icpt_scale + m * scale * L})
         return out
 
+    import dataclasses as _dc
+
     ctx = float(raw["meta"].get("decode_context", 1024))
     out = {k: v for k, v in raw.items() if k not in ("decode", "prefill", "mixed")}
     out["meta"] = dict(raw["meta"])
     out["meta"]["model"] = dst_model
-    out["meta"]["dims"] = {
-        "hidden": dst_dims.hidden, "n_heads": dst_dims.n_heads,
-        "n_kv_heads": dst_dims.n_kv_heads, "head_dim": dst_dims.head_dim,
-        "ffn": dst_dims.ffn, "vocab": dst_dims.vocab,
-        "n_layers_full": dst_dims.n_layers,
-    }
+    # full asdict record, same writer convention as tools/profile_tpu.py:
+    # dims_from_meta detects the family from the field set, so dropping
+    # family-specific fields here would mis-reconstruct a Gemma target
+    dims_meta = _dc.asdict(dst_dims)
+    dims_meta["n_layers_full"] = dims_meta.pop("n_layers")
+    out["meta"]["dims"] = dims_meta
     out["decode"] = rebuild(raw.get("decode", []), "step_ms", ("batch",),
                             lambda b: decode_scale(b, ctx))
     out["prefill"] = rebuild(raw.get("prefill", []), "prefill_ms",
@@ -488,10 +503,8 @@ def build_profile_json(
     cross_model: Mapping[str, Any] | None = None,
 ) -> dict:
     """Full profile document for one (model, slice shape)."""
-    dims_in = dict(raw["meta"]["dims"])
-    n_layers_full = dims_in.pop("n_layers_full")
-    dims_in["n_layers"] = n_layers_full
-    dims = LlamaDims(**dims_in)
+    dims = dims_from_meta(raw["meta"]["dims"])
+    n_layers_full = dims.n_layers
 
     def fit(multiplier: float):
         return fit_tpu_profile(
@@ -588,9 +601,8 @@ def attach_context_buckets(
     inherited from the base fit (TTFT is linear in prompt length there),
     and maxBatchSize is the KV-memory cap at the bucket's context length
     (SURVEY §5.7: long context as profile dimensions)."""
-    dims_in = dict(doc["measurement_meta"]["dims"])
-    n_layers_full = dims_in.pop("n_layers_full")
-    dims = LlamaDims(**dims_in, n_layers=n_layers_full)
+    dims = dims_from_meta(doc["measurement_meta"]["dims"])
+    n_layers_full = dims.n_layers
     buckets = []
     for max_in_tokens, raw_ctx in sorted(context_raws, key=lambda kv: kv[0]):
         decode, r2 = fit_decode_at_context(raw_ctx, n_layers_full, n_chips)

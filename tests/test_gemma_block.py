@@ -1,0 +1,172 @@
+"""Gemma-2 profiling block: architecture semantics the Llama block
+doesn't have (sandwich norms, softcaps, ALTERNATING sliding-window
+attention), pinned on the CPU float32 path so an on-chip sweep measures
+the real layer body. Family dispatch and the dims round-trip through the
+profiler's recorded meta are covered too."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from inferno_tpu.models.gemma_block import (
+    GEMMA_PRESETS,
+    GemmaDims,
+    _softcap,
+    init_stack,
+    make_decode_fn,
+    make_prefill_repeat_fn,
+)
+from inferno_tpu.models.profiles import dims_from_meta
+
+TINY = GemmaDims(hidden=32, n_heads=4, n_kv_heads=2, head_dim=8, ffn=64,
+                 vocab=96, n_layers=2, sliding_window=8,
+                 query_pre_attn_scalar=8.0)
+
+
+def _caches(dims, n_layers, batch, s_max, rng=None):
+    if rng is None:
+        return tuple(
+            jnp.zeros((batch, dims.n_kv_heads, s_max, dims.head_dim),
+                      dtype=jnp.float32)
+            for _ in range(2 * n_layers)
+        )
+    return tuple(
+        jnp.asarray(rng.normal(size=(batch, dims.n_kv_heads, s_max,
+                                     dims.head_dim)), dtype=jnp.float32)
+        for _ in range(2 * n_layers)
+    )
+
+
+def test_decode_runs_and_is_finite():
+    n_layers, batch, s_max = 2, 3, 24
+    params = init_stack(jax.random.PRNGKey(0), TINY, n_layers, "float32")
+    decode = make_decode_fn(TINY, n_layers, n_steps=4)
+    x0 = jnp.ones((batch, 1, TINY.hidden), dtype=jnp.float32) * 0.1
+    acc, x, caches = decode(params, x0, _caches(TINY, n_layers, batch, s_max), 16)
+    assert np.isfinite(float(acc))
+    assert x.shape == (batch, 1, TINY.hidden)
+    assert len(caches) == 2 * n_layers
+
+
+def test_sliding_window_alternates_by_layer_parity():
+    """Even layers use the sliding window, odd layers attend globally
+    (the Gemma-2 pattern): perturbing cached keys OUTSIDE the window
+    must not change the output through an even layer, and must change
+    it through an odd one."""
+    n_layers, batch, s_max, pos = 2, 1, 32, 16
+    params = init_stack(jax.random.PRNGKey(1), TINY, n_layers, "float32")
+    decode = make_decode_fn(TINY, n_layers, n_steps=1)
+    x0 = jnp.ones((batch, 1, TINY.hidden), dtype=jnp.float32) * 0.1
+    rng = np.random.default_rng(3)
+    base = _caches(TINY, n_layers, batch, s_max, rng)
+    _, x_base, _ = decode(params, x0, base, pos)
+
+    far = 2  # pos - far = 14 >= window 8: outside the sliding window
+    near = 12  # delta 4 < 8: inside
+
+    def poke(caches, layer, position):
+        k = np.array(caches[2 * layer])  # writable copy
+        k[:, :, position, :] += 7.0
+        out = list(caches)
+        out[2 * layer] = jnp.asarray(k)
+        return tuple(out)
+
+    # layer 0 (even, sliding): far keys invisible, near keys visible
+    _, x_far0, _ = decode(params, x0, poke(base, 0, far), pos)
+    np.testing.assert_allclose(np.asarray(x_base), np.asarray(x_far0),
+                               rtol=1e-6, atol=1e-7)
+    _, x_near0, _ = decode(params, x0, poke(base, 0, near), pos)
+    assert not np.allclose(np.asarray(x_base), np.asarray(x_near0))
+
+    # layer 1 (odd, global): even far keys are visible
+    _, x_far1, _ = decode(params, x0, poke(base, 1, far), pos)
+    assert not np.allclose(np.asarray(x_base), np.asarray(x_far1))
+
+
+def test_softcap_bounds_and_preserves_small_values():
+    x = jnp.asarray([-1000.0, -1.0, 0.0, 1.0, 1000.0], dtype=jnp.float32)
+    y = np.asarray(_softcap(x, 50.0))
+    assert np.all(np.abs(y) <= 50.0)
+    assert y[2] == 0.0
+    assert y[3] == pytest.approx(1.0, rel=1e-3)  # ~identity inside the cap
+
+
+def test_prefill_repeat_runs_with_alternating_masks():
+    n_layers = 3  # odd count: scan's parity select covers both branches
+    params = init_stack(jax.random.PRNGKey(2), TINY, n_layers, "float32")
+    prefill = make_prefill_repeat_fn(TINY, reps=2)
+    x = jnp.ones((2, 12, TINY.hidden), dtype=jnp.float32) * 0.05
+    assert np.isfinite(float(prefill(params, x)))
+
+
+def test_presets_match_published_dimensions():
+    d27 = GEMMA_PRESETS["gemma-2-27b"]
+    assert (d27.hidden, d27.n_layers, d27.n_heads, d27.n_kv_heads) == (4608, 46, 32, 16)
+    assert d27.query_pre_attn_scalar == pytest.approx(4608 / 32)
+    d9 = GEMMA_PRESETS["gemma-2-9b"]
+    assert (d9.hidden, d9.n_layers, d9.head_dim) == (3584, 42, 256)
+
+
+def test_dims_from_meta_round_trip_both_families():
+    """The profiler records dataclasses.asdict(dims) with n_layers_full;
+    dims_from_meta must reconstruct the exact family dataclass — and
+    older Llama-subset raws must keep loading."""
+    import dataclasses
+
+    meta = dataclasses.asdict(TINY)
+    meta["n_layers_full"] = meta.pop("n_layers")
+    back = dims_from_meta(meta)
+    assert isinstance(back, GemmaDims) and back == TINY
+
+    legacy = {"hidden": 4096, "n_heads": 32, "n_kv_heads": 8,
+              "head_dim": 128, "ffn": 14336, "vocab": 128256,
+              "n_layers_full": 32}
+    from inferno_tpu.models.llama_block import LlamaDims
+    ll = dims_from_meta(legacy)
+    assert isinstance(ll, LlamaDims) and ll.n_layers == 32
+
+
+def test_profile_pipeline_accepts_gemma_raw():
+    """A synthetic Gemma raw (known linear ground truth) flows through
+    the SAME fit pipeline as Llama raws — family only enters via the
+    recorded dims (duck-typed memory cap, softcap/window irrelevant to
+    the linear fit)."""
+    import dataclasses
+
+    from inferno_tpu.models.profiles import build_profile_json
+
+    dims_meta = dataclasses.asdict(GEMMA_PRESETS["gemma-2-9b"])
+    dims_meta["n_layers_full"] = dims_meta.pop("n_layers")
+    decode, prefill = [], []
+    for L in (2, 4, 8):
+        for b in (1, 8, 32):
+            decode.append({"n_layers": L, "batch": b, "context": 1024,
+                           "step_ms": 1.2 + L * (0.5 + 0.004 * b)})
+        for b in (1,):
+            for t in (128, 512, 2048):
+                prefill.append({"n_layers": L, "batch": b, "in_tokens": t,
+                                "prefill_ms": 1.2 + L * 0.002 * t})
+    raw = {"meta": {"model": "gemma-2-9b", "dims": dims_meta,
+                    "dtype": "bfloat16", "weight_dtype": "int8"},
+           "decode": decode, "prefill": prefill}
+    doc = build_profile_json(raw, "v5e-4-int8", n_chips=4,
+                             weight_bytes_per_param=1.0)
+    assert doc["name"] == "gemma-2-9b" and doc["derived"] is True
+    assert doc["maxBatchSize"] > 0  # a 9B int8 fits 4 v5e chips
+    assert doc["decodeParms"]["alpha"] > 0 and doc["prefillParms"]["delta"] > 0
+
+
+def test_profiler_family_dispatch():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import profile_tpu
+
+    from inferno_tpu.models import gemma_block, llama_block
+    assert profile_tpu.family_for("gemma-2-27b") is gemma_block
+    assert profile_tpu.family_for("llama-3.1-70b") is llama_block
+    assert "gemma-2-9b" in profile_tpu.ALL_PRESETS
+    assert getattr(gemma_block, "make_mixed_fn", None) is None  # pessimistic
+    # TTFT bound path documented in profile_depth

@@ -47,14 +47,22 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import jax
 import jax.numpy as jnp
 
-from inferno_tpu.models.llama_block import (
-    MODEL_PRESETS,
-    LlamaDims,
-    init_stack,
-    make_decode_fn,
-    make_mixed_fn,
-    make_prefill_repeat_fn,
-)
+from inferno_tpu.models import gemma_block, llama_block
+
+# Every preset across the measurable families; the layer-body module is
+# resolved per model, because a profile measured on the wrong block is a
+# wrong profile (Gemma-2's sandwich norms / softcaps / sliding window
+# are not Llama's layer — llama_block.MODEL_PRESETS note).
+ALL_PRESETS = {**llama_block.MODEL_PRESETS, **gemma_block.GEMMA_PRESETS}
+
+
+def family_for(model: str):
+    """The block module whose architecture `model` actually is —
+    membership in the family's own preset dict, NOT a name prefix: a
+    future Gemma entry not matching 'gemma-2*' must never silently
+    profile on the Llama block (GemmaDims duck-types everything the
+    Llama block touches, so nothing would crash)."""
+    return gemma_block if model in gemma_block.GEMMA_PRESETS else llama_block
 
 DECODE_BATCHES = [1, 2, 4, 8, 16, 24, 32, 48, 64, 96, 128]
 PREFILL_BATCHES = [1, 2, 4]
@@ -90,22 +98,23 @@ def _timed_ms(call, iters: int, rtt_ms: float, inner: int) -> float:
     return max(statistics.median(ts) - rtt_ms, 0.0) / inner
 
 
-def profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out, mixed_out, checkpoint, done):
+def profile_depth(blk, dims, n_layers, args, rtt_ms, decode_out, prefill_out, mixed_out, checkpoint, done):
+    has_mixed = getattr(blk, "make_mixed_fn", None) is not None
     needed = [("decode", n_layers, b, args.context) for b in args.decode_batches] + [
         ("prefill", n_layers, b, t)
         for b in args.prefill_batches for t in args.prefill_tokens
-    ] + [
+    ] + ([
         ("mixed", n_layers, b, t, args.context)
         for b in args.mixed_batches for t in args.mixed_tokens
-    ]
+    ] if has_mixed else [])
     if all(k in done for k in needed):
         print(f"depth L={n_layers}: fully measured, skipping init", flush=True)
         return
-    params = init_stack(jax.random.PRNGKey(n_layers), dims, n_layers, args.weight_dtype)
+    params = blk.init_stack(jax.random.PRNGKey(n_layers), dims, n_layers, args.weight_dtype)
     jax.block_until_ready(params)
 
     steps = args.decode_steps
-    decode = make_decode_fn(dims, n_layers, steps)
+    decode = blk.make_decode_fn(dims, n_layers, steps)
     for b in args.decode_batches:
         if ("decode", n_layers, b, args.context) in done:
             continue
@@ -133,30 +142,37 @@ def profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out, mixed_o
         checkpoint()
         del caches
 
-    msteps = max(4, args.decode_steps // 8)
-    mixed = make_mixed_fn(dims, n_layers, msteps)
-    for b in args.mixed_batches:
-        for t in args.mixed_tokens:
-            if ("mixed", n_layers, b, t, args.context) in done:
-                continue
-            s_max = args.context + msteps
-            caches = tuple(
-                jnp.zeros((b, dims.n_kv_heads, s_max, dims.head_dim), dtype=jnp.bfloat16)
-                for _ in range(2 * n_layers)
-            )
-            x = jnp.zeros((b, 1, dims.hidden), dtype=jnp.bfloat16)
-            chunk = jnp.ones((t, dims.hidden), dtype=jnp.bfloat16) * 0.01
-            ms = _timed_ms(
-                lambda: mixed(params, x, caches, chunk, jnp.int32(args.context))[0],
-                args.iters, rtt_ms, msteps,
-            )
-            mixed_out.append(
-                {"n_layers": n_layers, "batch": b, "in_tokens": t,
-                 "context": args.context, "step_ms": ms}
-            )
-            print(f"mixed   L={n_layers:2d} B={b:3d} T={t:5d}: {ms:8.3f} ms/step", flush=True)
-            checkpoint()
-            del caches
+    if not has_mixed:
+        # no mixed kernel for this family yet: the profile fit falls back
+        # to the strictly pessimistic decode(B)+prefill(1,T) TTFT bound
+        # (models/profiles.ttft_points), same as a raw without the sweep
+        print(f"mixed   L={n_layers:2d}: family has no mixed kernel; "
+              "TTFT calibration will use the pessimistic bound", flush=True)
+    else:
+        msteps = max(4, args.decode_steps // 8)
+        mixed = blk.make_mixed_fn(dims, n_layers, msteps)
+        for b in args.mixed_batches:
+            for t in args.mixed_tokens:
+                if ("mixed", n_layers, b, t, args.context) in done:
+                    continue
+                s_max = args.context + msteps
+                caches = tuple(
+                    jnp.zeros((b, dims.n_kv_heads, s_max, dims.head_dim), dtype=jnp.bfloat16)
+                    for _ in range(2 * n_layers)
+                )
+                x = jnp.zeros((b, 1, dims.hidden), dtype=jnp.bfloat16)
+                chunk = jnp.ones((t, dims.hidden), dtype=jnp.bfloat16) * 0.01
+                ms = _timed_ms(
+                    lambda: mixed(params, x, caches, chunk, jnp.int32(args.context))[0],
+                    args.iters, rtt_ms, msteps,
+                )
+                mixed_out.append(
+                    {"n_layers": n_layers, "batch": b, "in_tokens": t,
+                     "context": args.context, "step_ms": ms}
+                )
+                print(f"mixed   L={n_layers:2d} B={b:3d} T={t:5d}: {ms:8.3f} ms/step", flush=True)
+                checkpoint()
+                del caches
 
     for b in args.prefill_batches:
         for t in args.prefill_tokens:
@@ -168,7 +184,7 @@ def profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out, mixed_o
             reps = 1
             while reps < 64 and est * reps < args.target_ms:
                 reps *= 4
-            prefill = make_prefill_repeat_fn(dims, reps)
+            prefill = blk.make_prefill_repeat_fn(dims, reps)
             x = jnp.ones((b, t, dims.hidden), dtype=jnp.bfloat16) * 0.01
             ms = _timed_ms(lambda: prefill(params, x), args.iters, rtt_ms, reps)
             prefill_out.append(
@@ -183,7 +199,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="",
                     help="output JSON; default profiles/raw/<model>_tpu[_<dtype>].json")
-    ap.add_argument("--model", choices=sorted(MODEL_PRESETS), default="llama-3.1-8b")
+    ap.add_argument("--model", choices=sorted(ALL_PRESETS), default="llama-3.1-8b")
     ap.add_argument("--iters", type=int, default=7)
     ap.add_argument("--weight-dtype", choices=["bfloat16", "int8"], default="bfloat16")
     ap.add_argument("--decode-steps", type=int, default=64)
@@ -200,7 +216,8 @@ def main() -> None:
                     help="skip configs already present in --out (crash/tunnel-outage recovery)")
     args = ap.parse_args()
 
-    dims = MODEL_PRESETS[args.model]
+    dims = ALL_PRESETS[args.model]
+    blk = family_for(args.model)
     if not args.out:
         suffix = "" if args.weight_dtype == "bfloat16" else f"_{args.weight_dtype}"
         args.out = f"profiles/raw/{args.model}_tpu{suffix}.json"
@@ -239,13 +256,16 @@ def main() -> None:
 
     dev = jax.devices()[0]
     rtt_ms = measure_rtt()
+    import dataclasses as _dc
+
+    # full dims record (family-specific fields included) so downstream
+    # fits reconstruct the EXACT dataclass the sweep was measured with
+    # (models/profiles.dims_from_meta)
+    dims_meta = _dc.asdict(dims)
+    dims_meta["n_layers_full"] = dims_meta.pop("n_layers")
     meta = {
         "model": args.model,
-        "dims": {
-            "hidden": dims.hidden, "n_heads": dims.n_heads,
-            "n_kv_heads": dims.n_kv_heads, "head_dim": dims.head_dim,
-            "ffn": dims.ffn, "vocab": dims.vocab, "n_layers_full": dims.n_layers,
-        },
+        "dims": dims_meta,
         "device": {"kind": dev.device_kind, "platform": dev.platform},
         "jax_version": jax.__version__,
         "dtype": "bfloat16",
@@ -270,7 +290,7 @@ def main() -> None:
         )
 
     for n_layers in args.layer_depths:
-        profile_depth(dims, n_layers, args, rtt_ms, decode_out, prefill_out, mixed_out, checkpoint, done)
+        profile_depth(blk, dims, n_layers, args, rtt_ms, decode_out, prefill_out, mixed_out, checkpoint, done)
     meta["wall_clock_s"] = round(time.time() - t0, 1) + (meta.get("wall_clock_s") or 0)
     checkpoint()
     print(f"wrote {out} ({len(decode_out)} decode + {len(prefill_out)} prefill + "
