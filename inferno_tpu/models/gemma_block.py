@@ -268,6 +268,119 @@ def make_decode_fn(dims: GemmaDims, n_layers: int, n_steps: int):
     return jax.jit(decode)
 
 
+def _mixed_layer(x_all, split_b, layer_p, kv_cache, positions_dec, pos_chunk,
+                 mask_dec, mask_chunk, dims: GemmaDims, sliding: bool,
+                 k_positions):
+    """One Gemma-2 layer over a continuous-batching iteration (`split_b`
+    decode rows + one prefill chunk, sharing every weight matmul) —
+    the Gemma analogue of llama_block._mixed_layer, with sandwich norms,
+    GeGLU, softcaps, and the layer's sliding/global attention applied to
+    BOTH groups. x_all: (B + T, H)."""
+    b = split_b
+    h = _rmsnorm(x_all, layer_p["norm_attn_pre"])
+    q = _mm(h, layer_p["wq"])
+    k = _mm(h, layer_p["wk"])
+    v = _mm(h, layer_p["wv"])
+
+    # decode group: (B, 1, heads, hd) against the cache
+    qd = q[:b].reshape(b, 1, dims.n_heads, dims.head_dim)
+    kd = k[:b].reshape(b, 1, dims.n_kv_heads, dims.head_dim)
+    vd = v[:b].reshape(b, 1, dims.n_kv_heads, dims.head_dim)
+    qd = _rope(qd, positions_dec, dims.rope_theta)
+    kd = _rope(kd, positions_dec, dims.rope_theta).transpose(0, 2, 1, 3)
+    vd = vd.transpose(0, 2, 1, 3)
+    start = positions_dec[0, 0]
+    k_all = lax.dynamic_update_slice(kv_cache[0], kd, (0, 0, start, 0))
+    v_all = lax.dynamic_update_slice(kv_cache[1], vd, (0, 0, start, 0))
+    mask_d = (
+        _sliding_mask(mask_dec, positions_dec, k_positions, dims.sliding_window)
+        if sliding else mask_dec
+    )
+    attn_d = _gqa_attend(qd, k_all, v_all, mask_d, dims).reshape(b, dims.q_dim)
+
+    # chunk group: (1, T, heads, hd), causal (+ sliding) within the chunk
+    t = x_all.shape[0] - b
+    qc = q[b:].reshape(1, t, dims.n_heads, dims.head_dim)
+    kc = k[b:].reshape(1, t, dims.n_kv_heads, dims.head_dim)
+    vc = v[b:].reshape(1, t, dims.n_kv_heads, dims.head_dim)
+    qc = _rope(qc, pos_chunk, dims.rope_theta)
+    kc = _rope(kc, pos_chunk, dims.rope_theta).transpose(0, 2, 1, 3)
+    vc = vc.transpose(0, 2, 1, 3)
+    mask_c = (
+        _sliding_mask(mask_chunk, pos_chunk, pos_chunk, dims.sliding_window)
+        if sliding else mask_chunk
+    )
+    attn_c = _gqa_attend(qc, kc, vc, mask_c, dims).reshape(t, dims.q_dim)
+
+    attn = jnp.concatenate([attn_d, attn_c], axis=0)
+    x_all = x_all + _rmsnorm(_mm(attn, layer_p["wo"]), layer_p["norm_attn_post"])
+    h = _rmsnorm(x_all, layer_p["norm_mlp_pre"])
+    gated = jax.nn.gelu(_mm(h, layer_p["w_gate"]).astype(jnp.float32),
+                        approximate=True).astype(h.dtype)
+    mlp = _mm(gated * _mm(h, layer_p["w_up"]), layer_p["w_down"])
+    x_all = x_all + _rmsnorm(mlp, layer_p["norm_mlp_post"])
+    return x_all, (k_all, v_all)
+
+
+def make_mixed_fn(dims: GemmaDims, n_layers: int, n_steps: int):
+    """Jittable continuous-batching iteration (B decode rows + one
+    T-token prefill chunk per step, projections shared), API-identical
+    to llama_block.make_mixed_fn — so Gemma TTFT calibration measures
+    the real shared-iteration quantity instead of the pessimistic
+    decode+prefill upper bound."""
+
+    def one_step(params, x_dec, caches, chunk, pos):
+        b = x_dec.shape[0]
+        t = chunk.shape[0]
+        s_max = caches[0].shape[2]
+        positions_dec = jnp.broadcast_to(pos, (b, 1))
+        k_positions = jnp.broadcast_to(jnp.arange(s_max), (b, s_max))
+        valid = jnp.arange(s_max)[None, None, :] <= pos
+        mask_dec = jnp.broadcast_to(
+            jnp.where(valid, 0.0, -jnp.inf).astype(jnp.float32), (b, 1, s_max)
+        )
+        pos_chunk = jnp.broadcast_to(jnp.arange(t), (1, t))
+        causal = jnp.where(
+            jnp.arange(t)[:, None] >= jnp.arange(t)[None, :], 0.0, -jnp.inf
+        ).astype(jnp.float32)
+        mask_chunk = jnp.broadcast_to(causal, (1, t, t))
+
+        x_all = jnp.concatenate([x_dec[:, 0, :], chunk], axis=0)
+        new_caches = []
+        for li in range(n_layers):
+            layer_p = jax.tree.map(lambda w: w[li], params["layers"])
+            x_all, (k_c, v_c) = _mixed_layer(
+                x_all, b, layer_p, (caches[2 * li], caches[2 * li + 1]),
+                positions_dec, pos_chunk, mask_dec, mask_chunk, dims,
+                sliding=(li % 2 == 0), k_positions=k_positions,
+            )
+            new_caches.extend([k_c, v_c])
+        x_all = _rmsnorm(x_all, params["norm_out"])
+        logits = _softcap(
+            _mm(x_all, params["lm_head"]).astype(jnp.float32),
+            dims.final_softcap,
+        )
+        nxt = jnp.tanh(logits[:b, : dims.hidden]).astype(x_dec.dtype)[:, None, :]
+        return nxt, tuple(new_caches), jnp.sum(logits)
+
+    def mixed(params, x_dec, caches, chunk, start_pos):
+        def body(i, carry):
+            x_dec, caches, acc = carry
+            x_dec, caches, s = one_step(
+                params, x_dec, caches,
+                chunk * (1.0 + acc * 1e-30).astype(chunk.dtype),
+                start_pos + i,
+            )
+            return (x_dec, caches, acc + s * 1e-30)
+
+        x_dec, caches, acc = lax.fori_loop(
+            0, n_steps, body, (x_dec, caches, jnp.float32(0.0))
+        )
+        return acc + jnp.sum(x_dec.astype(jnp.float32)), x_dec, caches
+
+    return jax.jit(mixed)
+
+
 def make_prefill_repeat_fn(dims: GemmaDims, reps: int):
     """Jittable repeated causal prefill, API-identical to the Llama
     version (scan over stacked layers, data-dependence across reps so

@@ -15,6 +15,7 @@ from inferno_tpu.models.gemma_block import (
     _softcap,
     init_stack,
     make_decode_fn,
+    make_mixed_fn,
     make_prefill_repeat_fn,
 )
 from inferno_tpu.models.profiles import dims_from_meta
@@ -100,6 +101,44 @@ def test_prefill_repeat_runs_with_alternating_masks():
     assert np.isfinite(float(prefill(params, x)))
 
 
+def test_mixed_decode_rows_match_pure_decode():
+    """Gemma's shared continuous-batching iteration: the chunk rides
+    along WITHOUT changing the decode rows or caches (same contract the
+    Llama mixed kernel pins — otherwise mixed-step timings measure a
+    different computation than serving runs)."""
+    n_layers, batch, s_max, pos = 2, 3, 24, 16
+    params = init_stack(jax.random.PRNGKey(4), TINY, n_layers, "float32")
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.normal(size=(batch, 1, TINY.hidden)) * 0.1,
+                    dtype=jnp.float32)
+    chunk = jnp.asarray(rng.normal(size=(6, TINY.hidden)) * 0.1,
+                        dtype=jnp.float32)
+
+    decode = make_decode_fn(TINY, n_layers, 2)
+    _, x_dec, caches_dec = decode(
+        params, x, _caches(TINY, n_layers, batch, s_max,
+                           np.random.default_rng(5)), pos)
+    mixed = make_mixed_fn(TINY, n_layers, 2)
+    _, x_mix, caches_mix = mixed(
+        params, x, _caches(TINY, n_layers, batch, s_max,
+                           np.random.default_rng(5)), chunk, pos)
+    np.testing.assert_allclose(np.asarray(x_mix), np.asarray(x_dec),
+                               rtol=1e-5, atol=1e-5)
+    for cd, cm in zip(caches_dec, caches_mix):
+        np.testing.assert_allclose(np.asarray(cm), np.asarray(cd),
+                                   rtol=1e-5, atol=1e-5)
+    # ...and the chunk work actually happens (anti-DCE contract). Zero
+    # decode input: the returned scalar is then PURELY the 1e-30-scaled
+    # chunk-logit term, resolvable at float32 (with a random x the O(1)
+    # decode sum would swamp it)
+    mixed1 = make_mixed_fn(TINY, n_layers, 1)
+    x0 = jnp.zeros((batch, 1, TINY.hidden), dtype=jnp.float32)
+    zeros = _caches(TINY, n_layers, batch, s_max)
+    s1 = float(mixed1(params, x0, zeros, chunk, pos)[0])
+    s2 = float(mixed1(params, x0, zeros, chunk * 2.0, pos)[0])
+    assert s1 != s2
+
+
 def test_presets_match_published_dimensions():
     d27 = GEMMA_PRESETS["gemma-2-27b"]
     assert (d27.hidden, d27.n_layers, d27.n_heads, d27.n_kv_heads) == (4608, 46, 32, 16)
@@ -168,5 +207,9 @@ def test_profiler_family_dispatch():
     assert profile_tpu.family_for("gemma-2-27b") is gemma_block
     assert profile_tpu.family_for("llama-3.1-70b") is llama_block
     assert "gemma-2-9b" in profile_tpu.ALL_PRESETS
-    assert getattr(gemma_block, "make_mixed_fn", None) is None  # pessimistic
-    # TTFT bound path documented in profile_depth
+    # both families now expose the full profiling API incl. the mixed
+    # kernel, so Gemma TTFT calibration measures the shared iteration
+    for fn in ("init_stack", "make_decode_fn", "make_prefill_repeat_fn",
+               "make_mixed_fn"):
+        assert callable(getattr(gemma_block, fn))
+        assert callable(getattr(llama_block, fn))
