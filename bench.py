@@ -100,7 +100,8 @@ A100 = dict(
 )
 
 
-def usd_per_mtok(decode, prefill, max_batch, cost_per_replica_hr) -> dict:
+def usd_per_mtok(decode, prefill, max_batch, cost_per_replica_hr,
+                 arrival_rps: float = ARRIVAL_RPS) -> dict:
     """Size one accelerator type against the SLO at p99 and price the
     served tokens: replicas = ceil(rate/lambda*) (allocation.go:133-141),
     cost = replicas x unit cost (allocation.go:143-145)."""
@@ -116,8 +117,8 @@ def usd_per_mtok(decode, prefill, max_batch, cost_per_replica_hr) -> dict:
         ttft_tail_margin=P99_MARGIN,
     )
     lam_star = min(rates.rate_target_ttft, rates.rate_target_itl)  # req/s
-    replicas = max(1, math.ceil(ARRIVAL_RPS / lam_star))
-    tokens_per_hr = ARRIVAL_RPS * REQ.avg_out_tokens * 3600.0
+    replicas = max(1, math.ceil(arrival_rps / lam_star))
+    tokens_per_hr = arrival_rps * REQ.avg_out_tokens * 3600.0
     cost_per_hr = replicas * cost_per_replica_hr
     return {
         "usd_per_mtok": cost_per_hr / (tokens_per_hr / 1e6),
@@ -551,10 +552,21 @@ def fleet_cycle_metrics(full: bool = True) -> dict:
                 time_cycles(pallas_step, spec, 5), 3)
             out["lanes_512"]["pallas_vs_xla"] = round(
                 tpu_ms / out["lanes_512"]["pallas_ms"], 3)
+            out["pallas"] = {
+                "pallas_ms": out["lanes_512"]["pallas_ms"],
+                "pallas_vs_xla": out["lanes_512"]["pallas_vs_xla"],
+            }
         except Exception as exc:  # a pallas lowering regression must not
             # cost the whole bench artifact
             out["lanes_512"]["pallas_error"] = str(exc)[:200]
+            out["pallas"] = {"error": str(exc)[:200]}
         out["profile_drift"] = _profile_drift_check()
+    else:
+        # explicit skip records (VERDICT r5 §4): an absent key reads as a
+        # bench that never tried; a reader of the artifact must see that
+        # the on-chip blocks were skipped and why
+        out["profile_drift"] = {"skipped": "tpu unreachable"}
+        out["pallas"] = {"skipped": "tpu unreachable"}
 
     if full:
         # lane scaling: the batched path's advantage grows with fleet size
@@ -568,6 +580,16 @@ def fleet_cycle_metrics(full: bool = True) -> dict:
             "scalar_ms": round(scalar_4k_ms, 3),
             "vs_scalar": round(scalar_4k_ms / tpu_4k_ms, 3),
         }
+        if native_ms is not None:
+            # the production CPU backend's scaling, recorded next to
+            # XLA's (VERDICT r5 §7: native was only ever timed at 512)
+            try:
+                native_4k_ms = time_cycles(native_step, spec_4k, 3)
+                out["lanes_4096"]["native_ms"] = round(native_4k_ms, 3)
+                out["lanes_4096"]["vs_native"] = round(
+                    native_4k_ms / tpu_4k_ms, 3)
+            except Exception as exc:
+                out["lanes_4096"]["native_error"] = str(exc)[:200]
     return out
 
 
@@ -641,13 +663,18 @@ def _profile_drift_check() -> dict:
         return {"error": f"on-chip drift measurement failed: {str(exc)[:200]}"}
 
 
-def _pin_cpu_if_tpu_unreachable(timeout_s: float = 120.0) -> dict:
+def _pin_cpu_if_tpu_unreachable(timeout_s: float = 20.0) -> dict:
     """The TPU on this box sits behind a network tunnel that can be down
     for hours; jax backend init then hangs forever instead of failing.
     Probe device initialization in a subprocess with a timeout and pin
     the CPU platform for this process when the probe dies, so the bench
     always produces its JSON line (fleet-cycle timings are then CPU
     numbers; the north-star metric never needed a device).
+
+    The hang budget matches the reconciler probe's 20 s (VERDICT r5 §4:
+    every unreachable run burned 120 s for the same answer) — a healthy
+    attached TPU initializes in a few seconds, so 20 s is a generous hang
+    cutoff, not a race.
 
     Returns a provenance record for the output (round-4 verdict weak #2:
     every bench run must say whether the chip was probed and what
@@ -687,24 +714,43 @@ def _pin_cpu_if_tpu_unreachable(timeout_s: float = 120.0) -> dict:
 FULL_PAYLOAD_PATH = str(Path(__file__).resolve().parent / "bench_full.json")
 
 
-def measured_p99_at_benched_point(ns: dict) -> dict:
-    """MEASURE the p99 TTFT the headline promises (round-4 verdict weak
-    #4): drive the discrete-event emulator at the benched operating point
-    — the chosen shape's committed profile, the sized fleet's per-replica
-    arrival rate, the baseline workload shape (128/128) — and report the
-    observed percentile against the 500 ms SLO. The sizing itself applies
-    the exponential-tail p99 margin analytically (analyzer/queue.py);
-    this closes the 'modeled vs measured' gap at the exact point the
-    $/Mtok number is computed at."""
+def _drive_benched_point(prof: dict, rate: float, seed: int = 0,
+                         emu_duration_s: float = 16.0,
+                         min_rate_ratio: float = 0.98,
+                         attempts: int = 6) -> dict:
+    """Emulator run at an operating point: `prof` is a profile dict
+    (alpha/beta/gamma/delta/max_batch), `rate` the emulated per-replica
+    arrival rate. Shared by the conservative measured-p99 check, the
+    calibration ladder, and the calibrated-pick validation so all three
+    measure with identical machinery.
+
+    Arrivals are paced on the engine's virtual clock, so the only
+    realized-vs-target slack left is the Poisson count noise of the seed
+    (std ~1/sqrt(N) ≈ 3%); a realization that under-drives the point by
+    more than `min_rate_ratio` is REDRAWN with a fresh seed (VERDICT r5
+    §5: the measured p99 must validate the benched point, not a
+    several-percent-easier one). Returns the best realization."""
     from inferno_tpu.emulator.experiment import benched_point_scenario, run_scenario
 
-    prof = ns["profile"]
-    rate = ARRIVAL_RPS / ns["tpu"]["replicas"]
-    res = run_scenario(benched_point_scenario(
-        alpha=prof["alpha"], beta=prof["beta"], gamma=prof["gamma"],
-        delta=prof["delta"], max_batch=prof["max_batch"], rate_rps=rate,
-        in_tokens=REQ.avg_in_tokens, out_tokens=REQ.avg_out_tokens,
-    ))
+    best, best_ratio = None, -1.0
+    for attempt in range(attempts):
+        res = run_scenario(benched_point_scenario(
+            alpha=prof["alpha"], beta=prof["beta"], gamma=prof["gamma"],
+            delta=prof["delta"], max_batch=prof["max_batch"], rate_rps=rate,
+            in_tokens=REQ.avg_in_tokens, out_tokens=REQ.avg_out_tokens,
+            emu_duration_s=emu_duration_s, seed=seed + 1000 * attempt,
+        ))
+        ratio = res.get("measured_emu_rps_per_replica", 0.0) / rate
+        if ratio > best_ratio:
+            best, best_ratio = res, ratio
+        if ratio >= min_rate_ratio:
+            break
+    return best
+
+
+def _p99_record(res: dict, rate: float) -> dict:
+    """The measured-operating-point record shape shared by `measured_p99`
+    and every calibration validation run."""
     return {
         "p99_ttft_ms": round(res["ttft_ms"]["p99"], 1),
         "p95_ttft_ms": round(res["ttft_ms"]["p95"], 1),
@@ -719,12 +765,264 @@ def measured_p99_at_benched_point(ns: dict) -> dict:
     }
 
 
+def measured_p99_at_benched_point(ns: dict) -> dict:
+    """MEASURE the p99 TTFT the headline promises (round-4 verdict weak
+    #4): drive the discrete-event emulator at the benched operating point
+    — the chosen shape's committed profile, the sized fleet's per-replica
+    arrival rate, the baseline workload shape (128/128) — and report the
+    observed percentile against the 500 ms SLO. The sizing itself applies
+    the exponential-tail p99 margin analytically (analyzer/queue.py);
+    this closes the 'modeled vs measured' gap at the exact point the
+    $/Mtok number is computed at."""
+    rate = ARRIVAL_RPS / ns["tpu"]["replicas"]
+    return _p99_record(_drive_benched_point(ns["profile"], rate), rate)
+
+
+# ---------------------------------------------------------------------------
+# Closed-loop calibration harvest: corrected mu(n) sizing, emulator-validated
+# ---------------------------------------------------------------------------
+
+# The live reconciler's corrector keeps its wide default band (1.2) as
+# flapping hysteresis against noisy telemetry; the bench calibrates
+# against the low-noise discrete-event emulator, where a 2% dead zone is
+# enough to reject run-to-run jitter while catching the ~10% model
+# conservatism the bench itself measures (model_error.itl_rel).
+CALIBRATION_RESIDUAL_BAND = 1.02
+# ladder of operating points as fractions of the conservative per-replica
+# lambda*: spread in concurrency lets the corrector's surrogate refit see
+# the shape of ITL(n), and every point stays inside the UNcorrected
+# model's stable range (realized rate overshoots target by a few percent)
+CALIBRATION_LADDER = (0.5, 0.65, 0.8, 0.92)
+
+
+def calibrated_headline(
+    prof: dict,
+    conservative: dict,
+    cost_per_replica_hr: float,
+    arrival_rps: float = ARRIVAL_RPS,
+    seeds: int = 3,
+    emu_duration_s: float = 16.0,
+    slo_itl_ms: float = SLO_ITL_MS,
+) -> dict:
+    """Harvest the measured model conservatism (VERDICT r5 weak #1): the
+    analytic M/M/1/K sizing overestimates ITL at the benched operating
+    point by ~10% (`measured_p99.model_error`), which overcounts replicas
+    and inflates $/Mtok. Close the loop with the existing corrector
+    machinery (models/corrector.py):
+
+    1. drive the discrete-event emulator over a rate ladder at the
+       benched point and feed each run's (model-coordinate concurrency,
+       measured ITL/TTFT) into a ProfileCorrector. Observations are in
+       MODEL coordinates — concurrency is the analyzer's own effective-
+       concurrency estimate at the realized rate — because the corrected
+       parms are consumed by the analyzer at exactly those coordinates;
+       folding the residual in model coordinates is what cancels the
+       structural bias (the emulator follows the linear profile by
+       construction, so realized-coordinate residuals are ~1);
+    2. re-size with the corrected mu(n) (same usd_per_mtok arithmetic as
+       the conservative headline);
+    3. validate the corrected pick with fresh emulator runs at the
+       re-sized per-replica rate, walking the replica count back up
+       toward the conservative pick until the measured p99 TTFT and mean
+       ITL meet the SLO. The VALIDATION RUN, not the analytic stability
+       margin, is the acceptance gate: corrected alpha/beta move
+       lambda_max itself, and the 0.9 STABILITY_SAFETY_FRACTION cap only
+       guards TPS targets (inactive here), so an over-correction can
+       claim rates the engine cannot sustain — see the stability note in
+       models/corrector.py.
+
+    Returns a provenance-marked block. `harvested: false` carries an
+    explicit finding string recording WHY the slack was not harvestable."""
+    from inferno_tpu.models.corrector import Observation, ProfileCorrector
+
+    decode = DecodeParms(alpha=prof["alpha"], beta=prof["beta"])
+    prefill = PrefillParms(gamma=prof["gamma"], delta=prof["delta"])
+    lam0 = conservative["rate_per_replica"]
+    corrector = ProfileCorrector(residual_band=CALIBRATION_RESIDUAL_BAND)
+    key = "benched-point"
+    ladder = []
+    for frac in CALIBRATION_LADDER:
+        for seed in range(seeds):
+            res = _drive_benched_point(prof, frac * lam0, seed=seed,
+                                       emu_duration_s=emu_duration_s)
+            model = res.get("model") or {}
+            if "concurrency" not in model:
+                continue  # realized rate left the model's stable range
+            corrector.observe(key, Observation(
+                concurrency=model["concurrency"],
+                in_tokens=REQ.avg_in_tokens,
+                out_tokens=REQ.avg_out_tokens,
+                itl_ms=res["itl_ms"]["mean"],
+                ttft_ms=res["ttft_ms"]["mean"],
+            ))
+            ladder.append({
+                "target_rate_rps": round(frac * lam0, 2),
+                "realized_emu_rps": round(res["measured_emu_rps_per_replica"], 2),
+                "model_concurrency": round(model["concurrency"], 1),
+                "model_itl_ms": round(model["itl_ms"], 3),
+                "measured_itl_ms": round(res["itl_ms"]["mean"], 3),
+            })
+
+    corr_decode, corr_prefill, state = corrector.corrected_parms(
+        key, decode, prefill
+    )
+    out = {
+        "provenance": "calibrated-emulator",
+        "method": (
+            "ProfileCorrector over a discrete-event-emulator rate ladder at "
+            "the benched point; corrected mu(n) re-sizing; fresh emulator "
+            "validation run as the acceptance gate (replica back-off on "
+            "SLO miss)"
+        ),
+        "residual_band": CALIBRATION_RESIDUAL_BAND,
+        "observations": state.observations,
+        "ladder": ladder,
+        "conservative": {
+            "usd_per_mtok": round(conservative["usd_per_mtok"], 4),
+            "replicas": conservative["replicas"],
+            "rate_per_replica": round(lam0, 2),
+        },
+    }
+    if not state.active:
+        out["harvested"] = False
+        out["finding"] = (
+            f"profile residuals stayed within the {CALIBRATION_RESIDUAL_BAND} "
+            f"calibration band over {len(ladder)} emulator runs: the measured "
+            "conservatism is not attributable to mu(n) and profile correction "
+            "cannot harvest it"
+        )
+        return out
+
+    out["correction"] = {
+        "decode_ratio": round(state.decode_ratio, 4),
+        "prefill_ratio": round(state.prefill_ratio, 4),
+        "surrogate_used": state.surrogate_used,
+        "alpha": round(corr_decode.alpha, 4),
+        "beta": round(corr_decode.beta, 6),
+        "gamma": round(corr_prefill.gamma, 4),
+        "delta": round(corr_prefill.delta, 8),
+    }
+    try:
+        proposed = usd_per_mtok(corr_decode, corr_prefill, prof["max_batch"],
+                                cost_per_replica_hr, arrival_rps=arrival_rps)
+    except AnalyzerError as e:
+        out["harvested"] = False
+        out["finding"] = f"corrected profile is SLO-infeasible: {e}"
+        return out
+    # evidence-range guard: the corrected curve is a LOCAL linearization
+    # over the observed ladder; a refit with a too-flat slope can claim
+    # per-replica rates far beyond any measured operating point (the
+    # surrogate extrapolating past the observed concurrency range). Cap
+    # the proposal at 15% beyond the fastest rate the ladder actually
+    # realized — the validation loop below remains the acceptance gate,
+    # this just starts the back-off near the evidence.
+    max_observed = max(row["realized_emu_rps"] for row in ladder)
+    evidence_floor = max(1, math.ceil(arrival_rps / (1.15 * max_observed)))
+    out["proposed"] = {
+        "replicas": proposed["replicas"],
+        "rate_per_replica": round(proposed["rate_per_replica"], 2),
+        "usd_per_mtok": round(proposed["usd_per_mtok"], 4),
+        "evidence_floor_replicas": evidence_floor,
+    }
+
+    # validation: fresh emulator runs at the corrected pick, backing off
+    # one replica at a time until the MEASURED point meets the SLOs. The
+    # loop only covers counts STRICTLY below the conservative pick — the
+    # conservative headline is already measured by measured_p99, so a
+    # start at/above it means there is simply nothing cheaper to validate
+    start = max(1, proposed["replicas"], evidence_floor)
+    if start >= conservative["replicas"]:
+        out["harvested"] = False
+        out["finding"] = (
+            f"corrected mu(n) sizing proposes {proposed['replicas']} replicas "
+            f"(evidence floor {evidence_floor}) — not below the conservative "
+            f"{conservative['replicas']}: the correction is pessimistic or "
+            "evidence-bounded at this operating point, so there is no "
+            "harvestable slack"
+        )
+        return out
+
+    validation_runs = []
+    validated = None
+    for replicas in range(start, conservative["replicas"]):
+        rate = arrival_rps / replicas
+        rec = _p99_record(
+            _drive_benched_point(prof, rate, seed=101 + replicas,
+                                 emu_duration_s=emu_duration_s),
+            rate,
+        )
+        accepted = (
+            rec["meets_slo"]
+            and rec["mean_itl_ms"] <= slo_itl_ms
+            and rec["realized_emu_rps"] >= 0.98 * rec["target_rate_rps"]
+        )
+        validation_runs.append(
+            {"replicas": replicas, "accepted": accepted, **rec}
+        )
+        if accepted:
+            validated = (replicas, rec)
+            break
+    out["validation_runs"] = validation_runs
+
+    if validated is None:
+        out["harvested"] = False
+        out["finding"] = (
+            f"corrected mu(n) proposed {proposed['replicas']} replicas, but "
+            f"every validated count below the conservative "
+            f"{conservative['replicas']} missed the p99-TTFT/ITL SLOs in the "
+            "emulator — the modeled slack is not harvestable (see "
+            "validation_runs for the measured misses)"
+        )
+        return out
+    replicas, rec = validated
+
+    tokens_per_hr = arrival_rps * REQ.avg_out_tokens * 3600.0
+    usd = replicas * cost_per_replica_hr / (tokens_per_hr / 1e6)
+    out["harvested"] = True
+    out["usd_per_mtok"] = round(usd, 4)
+    out["replicas"] = replicas
+    out["validated"] = {"replicas": replicas, **rec}
+    out["headline_delta_pct"] = round(
+        100.0 * (usd / conservative["usd_per_mtok"] - 1.0), 1
+    )
+    out["stability"] = {
+        "note": (
+            "corrected alpha/beta rescale mu(n), so lambda_max moves with the "
+            "correction; the 0.9 STABILITY_SAFETY_FRACTION cap applies only "
+            "to TPS targets (inactive at this SLO), so the emulator "
+            "validation run above — not the analytic margin — is the "
+            "acceptance gate for the calibrated pick"
+        ),
+        "conservative_binding": "itl",
+        "validated_rate_vs_uncorrected_lambda_max": round(
+            (arrival_rps / replicas)
+            / (service_rate_ceiling(decode, prefill, prof["max_batch"]) * 1000.0),
+            4,
+        ),
+    }
+    return out
+
+
+def service_rate_ceiling(decode, prefill, max_batch: int) -> float:
+    """mu(max_batch) in req/msec for the benched workload — the
+    UNcorrected stable-rate ceiling the stability note reports against."""
+    from inferno_tpu.analyzer.queue import service_rates
+
+    return float(service_rates(decode, prefill, REQ, max_batch)[-1])
+
+
 def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
-                       measured_p99: dict | None = None) -> dict:
+                       measured_p99: dict | None = None,
+                       calibrated: dict | None = None) -> dict:
     """Everything the bench measures, in one document — written to
     `bench_full.json`, NOT printed (the printed line is `compact_line`)."""
     return {
         **({"measured_p99": measured_p99} if measured_p99 else {}),
+        # the closed-loop calibration harvest, provenance-marked: sits
+        # NEXT TO the conservative headline (metric/value below), never
+        # replaces it — `calibrated.harvested` says whether the corrected
+        # mu(n) sizing validated cheaper
+        **({"calibrated": calibrated} if calibrated else {}),
         "metric": "usd_per_mtok_at_p99_ttft_slo",
         "value": round(ns["tpu"]["usd_per_mtok"], 4),
         "unit": "USD/Mtok",
@@ -761,36 +1059,87 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
     }
 
 
+# optional `extra` fields in drop order on a 1024-byte overflow: least
+# headline-critical first (the full payload always carries everything)
+_COMPACT_DROP_ORDER = (
+    "fleet_cycle_platform",
+    "fleet_cycle_ms",
+    "a100_usd_per_mtok",
+    "headline_provenance",
+    "tpu_reachable",
+    "p99_ttft_measured_ms",
+    "p99_meets_slo",
+    "calibrated_replicas",
+    "chosen_shape",
+    "calibrated_usd_per_mtok",
+)
+
+
 def compact_line(ns: dict, cycles: dict, tpu_probe: dict,
-                 measured_p99: dict | None = None) -> str:
+                 measured_p99: dict | None = None,
+                 calibrated: dict | None = None) -> str:
     """The ONE printed JSON line. Round-4 postmortem: the driver captures
     only a tail window of stdout, and round 4's ~4 KB single line was cut
     mid-object (`BENCH_r04.json parsed: null`) — a benchmark whose number
     the scoring pipeline can't read didn't happen. So the printed line is
     a compact headline (well under any plausible tail window) and the full
-    payload lives in `bench_full.json`, referenced by path."""
-    line = json.dumps({
+    payload lives in `bench_full.json`, referenced by path.
+
+    On overflow this DEGRADES instead of raising (ADVICE r5): raising
+    produced zero bench output, the exact failure the contract guards
+    against. Degradation order: swap the absolute payload path for the
+    repo-relative one (its length varies with checkout depth), then drop
+    optional extras least-critical-first; the bare headline quadruple
+    always fits."""
+    extra = {
+        "chosen_shape": ns["chosen_shape"],
+        "headline_provenance": ns["per_shape_provenance"][ns["chosen_shape"]],
+        "a100_usd_per_mtok": round(ns["a100"]["usd_per_mtok"], 4),
+        "tpu_reachable": tpu_probe.get("reachable", False),
+        "fleet_cycle_platform": cycles["platform"],
+        "fleet_cycle_ms": cycles["auto_selected_ms"],
+        **({"p99_ttft_measured_ms": measured_p99["p99_ttft_ms"],
+            "p99_meets_slo": measured_p99["meets_slo"]}
+           if measured_p99 else {}),
+        **(
+            ({"calibrated_usd_per_mtok": calibrated["usd_per_mtok"],
+              "calibrated_replicas": calibrated["replicas"]}
+             if calibrated.get("harvested")
+             else {"calibrated_usd_per_mtok": None})
+            if calibrated else {}
+        ),
+        "full_payload": FULL_PAYLOAD_PATH,
+    }
+    doc = {
         "metric": "usd_per_mtok_at_p99_ttft_slo",
         "value": round(ns["tpu"]["usd_per_mtok"], 4),
         "unit": "USD/Mtok",
         "vs_baseline": round(ns["vs_baseline"], 3),
-        "extra": {
-            "chosen_shape": ns["chosen_shape"],
-            "headline_provenance": ns["per_shape_provenance"][ns["chosen_shape"]],
-            "a100_usd_per_mtok": round(ns["a100"]["usd_per_mtok"], 4),
-            "tpu_reachable": tpu_probe.get("reachable", False),
-            "fleet_cycle_platform": cycles["platform"],
-            "fleet_cycle_ms": cycles["auto_selected_ms"],
-            **({"p99_ttft_measured_ms": measured_p99["p99_ttft_ms"],
-                "p99_meets_slo": measured_p99["meets_slo"]}
-               if measured_p99 else {}),
-            "full_payload": FULL_PAYLOAD_PATH,
-        },
-    })
-    if len(line) >= 1024:  # not an assert: must survive python -O, and an
-        # oversized line silently re-creates the round-4 truncation failure
-        raise RuntimeError(f"compact bench line grew to {len(line)}B; trim it")
-    return line
+        "extra": extra,
+    }
+    line = json.dumps(doc)
+    if len(line) < 1024:
+        return line
+    # degrade 1: repo-relative payload pointer (its absolute form varies
+    # with checkout depth — the advisor's observed overflow cause)
+    payload = Path(FULL_PAYLOAD_PATH)
+    try:
+        extra["full_payload"] = str(
+            payload.relative_to(Path(__file__).resolve().parent)
+        )
+    except ValueError:  # payload relocated outside the repo: name only
+        extra["full_payload"] = payload.name
+    # degrade 2: drop optional extras, least headline-critical first
+    for key in _COMPACT_DROP_ORDER:
+        line = json.dumps(doc)
+        if len(line) < 1024:
+            return line
+        extra.pop(key, None)
+    line = json.dumps(doc)
+    if len(line) < 1024:
+        return line
+    # last resort: the bare headline quadruple (always a few hundred bytes)
+    return json.dumps({k: doc[k] for k in ("metric", "value", "unit", "vs_baseline")})
 
 
 def main() -> None:
@@ -801,12 +1150,21 @@ def main() -> None:
     tpu_probe = _pin_cpu_if_tpu_unreachable()
     ns = north_star()
     measured = measured_p99_at_benched_point(ns)
+    # closed-loop calibration at the benched point: --quick runs a 2-seed
+    # ladder (8 observations — exercises the corrector's ratio-fallback
+    # path), the full bench a 3-seed ladder (12 — surrogate-eligible)
+    prof = ns["profile"]
+    calibrated = calibrated_headline(
+        prof, ns["tpu"], prof["chips"] * V5E_CHIP_HR,
+        seeds=2 if args.quick else 3,
+    )
     cycles = fleet_cycle_metrics(full=not args.quick)
     Path(FULL_PAYLOAD_PATH).write_text(
-        json.dumps(build_full_payload(ns, cycles, tpu_probe, measured),
+        json.dumps(build_full_payload(ns, cycles, tpu_probe, measured,
+                                      calibrated),
                    indent=1) + "\n"
     )
-    print(compact_line(ns, cycles, tpu_probe, measured))
+    print(compact_line(ns, cycles, tpu_probe, measured, calibrated))
 
 
 if __name__ == "__main__":

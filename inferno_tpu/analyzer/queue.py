@@ -33,6 +33,19 @@ deliberate departures:
 
 Units follow the reference: rates are requests/sec at the public API and
 requests/msec internally; times are msec.
+
+Calibration note: the alpha/beta/gamma/delta fed in here may be
+corrector-calibrated rather than CR-carried (models/corrector.py; the
+reconciler rewrites the ModelPerfSpec parms in place, so this analyzer,
+the batched XLA kernel in ops/queueing.py, and the C++ backend all see
+the same corrected curve). Corrected parms rescale mu(n) and therefore
+lambda_max itself — the sizing bisection in size_with_targets admits
+rates up to the CORRECTED ceiling. The STABILITY_SAFETY_FRACTION (0.9)
+headroom cap only applies to explicit TPS targets, so latency-target
+sizing on an optimistically-corrected curve has no analytic guard:
+consumers acting on corrected sizing at fleet scale validate against
+measurement first (bench.py's calibrated block walks the corrected pick
+back against fresh emulator runs).
 """
 
 from __future__ import annotations

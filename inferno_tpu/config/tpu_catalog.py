@@ -84,6 +84,28 @@ TPU_GENERATIONS: dict[str, GenerationSpec] = {
 }
 
 
+def generation_from_device_kind(kind: str) -> GenerationSpec:
+    """Resolve a jax `device_kind` string (recorded by tools/profile_tpu.py
+    under raw meta.device.kind) to its generation: "TPU v5 lite" -> v5e,
+    "TPU v5p"/"TPU v5" -> v5p, "TPU v6 lite"/"TPU v6e"/Trillium -> v6e.
+
+    Raises ValueError for unknown kinds — the cross-generation/cross-model
+    derivations rescale from the SOURCE generation's hardware constants, so
+    silently assuming a generation would rescale from the wrong baseline
+    (ADVICE r5: build_cross_model hardcoded v5e)."""
+    k = kind.lower()
+    if "v5 lite" in k or "v5e" in k or "v5litepod" in k:
+        return TPU_GENERATIONS["v5e"]
+    if "v6 lite" in k or "v6e" in k or "trillium" in k:
+        return TPU_GENERATIONS["v6e"]
+    if "v5p" in k or "v5" in k:
+        return TPU_GENERATIONS["v5p"]
+    raise ValueError(
+        f"cannot resolve TPU generation from device kind {kind!r} "
+        f"(known: {sorted(TPU_GENERATIONS)})"
+    )
+
+
 def _v5e(chips: int, topology: str) -> SliceShape:
     return SliceShape(f"v5e-{chips}", "v5e", topology, chips)
 

@@ -174,6 +174,11 @@ class CycleReport:
     variants_seen: int = 0
     variants_prepared: int = 0
     variants_applied: int = 0
+    # variants sized with corrector-calibrated (non-CR) profile parms this
+    # cycle: observability for the closed calibration loop — a count that
+    # flaps across cycles under steady telemetry is the no-flapping bug
+    # the corrector's hysteresis band exists to prevent
+    corrections_active: int = 0
     optimization_ok: bool = True
     solver_ms: float = 0.0
     analysis_ms: float = 0.0
@@ -540,6 +545,7 @@ class Reconciler:
                     corr_key, perf.decode_parms, perf.prefill_parms
                 )
                 if corr_state.active:
+                    report.corrections_active += 1
                     self.log.info(
                         "profile correction active for %s: decode x%.2f "
                         "prefill x%.2f (surrogate=%s, %d obs)",
@@ -760,6 +766,7 @@ class Reconciler:
                 variants_seen=report.variants_seen,
                 variants_prepared=report.variants_prepared,
                 variants_applied=report.variants_applied,
+                corrections_active=report.corrections_active,
                 optimization_ok=report.optimization_ok,
                 analysis_ms=round(report.analysis_ms, 3),
                 solver_ms=round(report.solver_ms, 3),

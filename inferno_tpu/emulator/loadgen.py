@@ -74,7 +74,18 @@ class LoadGenerator:
         seed: int = 0,
         in_dist: TokenDistribution | None = None,
         out_dist: TokenDistribution | None = None,
+        schedule_clock=None,
+        wall_per_unit: float = 1.0,
     ):
+        """`schedule_clock` (optional) makes the arrival schedule run on a
+        caller-supplied clock instead of wall time: a zero-arg callable
+        returning seconds in schedule units — e.g. an EmulatedEngine's
+        virtual clock (`lambda: engine.emu_ms / 1000.0`), so the RateSpec
+        is then in EMULATED seconds/req-per-emulated-second and the
+        realized emulated rate tracks the schedule by construction, with
+        no wall-overhead distortion (the bench's benched-point runs use
+        this). `wall_per_unit` estimates wall seconds per schedule second
+        (the engine's time_scale) so waits sleep instead of spinning."""
         self.engines = engines
         self.rate = rate
         self.in_tokens = in_tokens
@@ -84,23 +95,58 @@ class LoadGenerator:
         self.poisson = poisson
         self.rng = np.random.default_rng(seed)
         self.submitted = 0
+        self.schedule_clock = schedule_clock
+        self.wall_per_unit = wall_per_unit
+        # schedule seconds actually elapsed when the run finished (~ the
+        # schedule duration): the denominator for an unbiased realized
+        # rate — engine-side clocks include thread-startup idle
+        self.elapsed = 0.0
         self._thread: threading.Thread | None = None
 
+    def _clock(self):
+        """Elapsed schedule seconds since generator start."""
+        if self.schedule_clock is None:
+            start = time.time()
+            return lambda: time.time() - start
+        c0 = self.schedule_clock()
+        return lambda: self.schedule_clock() - c0
+
     def _run(self) -> None:
-        start = time.time()
+        clock = self._clock()
         i = 0
+        # Absolute-schedule pacing: arrival times are generated on the
+        # schedule clock and slept-to, so per-sleep overshoot (timer
+        # granularity + submit() host cost, ~0.5-1.5 ms each) is absorbed
+        # by the next gap instead of accumulating. The naive
+        # sleep-per-gap loop under-drove high-rate schedules by 10-50%
+        # (gaps of ~1 ms vs ~1 ms overhead), which made the bench's
+        # "measured p99 at the benched point" validate a materially
+        # easier operating point than promised (VERDICT r5 §5).
+        next_at = 0.0
         while True:
-            t = time.time() - start
+            t = clock()
             if t >= self.rate.total_duration:
+                self.elapsed = t
                 return
             rate = self.rate.rate_at(t)
             if rate <= 0:
-                time.sleep(0.01)
+                next_at = max(next_at, t) + 0.01
+                time.sleep(0.01 * self.wall_per_unit)
                 continue
             gap = (
                 float(self.rng.exponential(1.0 / rate)) if self.poisson else 1.0 / rate
             )
-            time.sleep(gap)
+            next_at += gap
+            if self.schedule_clock is None:
+                delay = next_at - clock()
+                if delay > 0:
+                    time.sleep(delay)
+            else:
+                # a non-wall clock advances on its own cadence (e.g. the
+                # engine's step quanta): sleep in short wall slices and
+                # re-read until the schedule reaches the arrival time
+                while (remaining := next_at - clock()) > 0:
+                    time.sleep(min(remaining * self.wall_per_unit, 0.002))
             # round-robin across replicas (a crude load balancer)
             engine = self.engines[i % len(self.engines)]
             i += 1

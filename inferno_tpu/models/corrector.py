@@ -24,6 +24,36 @@ concurrency) leaves the calibration band:
 With fewer observations than the surrogate needs, correction falls back
 to the same bounded multiplicative scaling for decode, so calibration
 degrades gracefully rather than flapping.
+
+Stability properties (the no-flapping contract the reconciler and the
+bench's closed-loop calibration rely on):
+
+* **Hysteresis.** Correction ACTIVATES when the median residual leaves
+  `residual_band` (default 1.2 — deliberately wide for live telemetry,
+  which folds scrape jitter and load-balancer skew into the residual),
+  and once active it RELEASES only when the residual comes back inside
+  the narrower `sqrt(residual_band)` (~1.095 at the default): a residual
+  hovering at the activation edge cannot toggle correction on and off
+  across cycles, which would flap the sized replica count. Offline
+  calibration against the low-noise discrete-event emulator (bench.py)
+  constructs the corrector with a much tighter band — the band is
+  evidence-noise policy, not model policy.
+* **Bounded corrections.** Multiplicative corrections are clamped to
+  CORRECTION_BOUNDS, so one window of corrupt telemetry cannot move the
+  sizing by more than 4x in either direction.
+* **Stability-cap interaction.** Corrected alpha/beta rescale the whole
+  service-rate curve mu(n), so the analyzer's stable-rate ceiling
+  lambda_max = mu(max_batch)·(1-RATE_EPSILON) moves WITH the correction:
+  an optimistic correction (ratio < 1) raises the rate the sizing will
+  admit per replica. The 0.9 throughput-headroom cap
+  (STABILITY_SAFETY_FRACTION, config/defaults.py) applies only to
+  explicit TPS targets and does NOT guard latency-target sizing, which
+  binds via bisection against the corrected curve — so an over-correction
+  can claim rates the real engine cannot sustain. Consumers must
+  therefore validate corrected sizing against measurement before acting
+  at fleet scale (bench.py walks the corrected pick back replica by
+  replica against a fresh emulator run; the live loop is protected by the
+  hysteresis band + bounds above and by re-observing every cycle).
 """
 
 from __future__ import annotations
@@ -124,7 +154,15 @@ class ProfileCorrector:
         log_ratio = np.log(obs_itl / np.maximum(pred_itl, 1e-9))
         median_ratio = float(np.exp(np.median(log_ratio)))
 
-        if abs(math.log(max(median_ratio, 1e-9))) <= math.log(self.residual_band):
+        # Hysteresis (no-flapping): activation needs the residual outside
+        # the full band, but an ALREADY-ACTIVE correction releases only
+        # when the residual returns inside the narrower sqrt(band) — a
+        # residual hovering at the activation edge must not toggle the
+        # sizing between corrected and uncorrected parms across cycles.
+        prev = self._state.get(key, CorrectionState())
+        was_active = prev.active
+        band = math.sqrt(self.residual_band) if was_active else self.residual_band
+        if abs(math.log(max(median_ratio, 1e-9))) <= math.log(band):
             self._state[key] = state
             return decode, prefill, state
 
@@ -158,7 +196,14 @@ class ProfileCorrector:
             np.maximum(obs_ttft, 1e-9) / np.maximum(pred_prefill, 1e-9)
         ))))
         new_prefill = prefill
-        if p_ratio > self.residual_band:
+        # same hysteresis as decode: an active prefill correction holds
+        # until the residual falls inside the sqrt(band) release band
+        p_band = (
+            math.sqrt(self.residual_band)
+            if was_active and prev.prefill_ratio != 1.0
+            else self.residual_band
+        )
+        if p_ratio > p_band:
             state.prefill_ratio = _clamp(p_ratio)
             new_prefill = PrefillParms(
                 gamma=prefill.gamma * state.prefill_ratio,

@@ -19,7 +19,11 @@ one jitted program:
   (inferno_tpu.parallel.fleet) so small lanes don't pay for large grids.
 
 Scalar semantics are defined by `inferno_tpu.analyzer.queue`; tests check this
-module against it lane by lane.
+module against it lane by lane — including with corrector-calibrated
+alpha/beta/gamma/delta in the FleetParams lanes (models/corrector.py
+rewrites the ModelPerfSpec parms upstream, so corrected and CR-carried
+profiles take the identical code path here; tests/test_fleet.py pins the
+corrected-parms scalar<->batched parity).
 """
 
 from __future__ import annotations

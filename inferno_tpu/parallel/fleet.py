@@ -8,6 +8,12 @@ SURVEY §3.3): it flattens every loaded (server, slice-shape) pair into one
 writes `Allocation` objects back onto the servers, including the
 zero-load shortcut and transition-penalty values that the scalar path
 produces (reference: pkg/core/{server.go:55-67, allocation.go:27-163}).
+
+The parms packed into each lane are whatever the System carries — when
+the reconciler's profile corrector is active (models/corrector.py), the
+lane columns are the CALIBRATED alpha/beta/gamma/delta, not the CR's, so
+live calibration flows through the batched path with no interface change
+(scalar<->batched parity on corrected parms: tests/test_fleet.py).
 """
 
 from __future__ import annotations

@@ -119,9 +119,11 @@ def test_measured_p99_meets_slo_at_benched_point(ns):
     conservative."""
     measured = bench.measured_p99_at_benched_point(ns)
     assert measured["requests"] >= 300  # enough tail samples for a p99
-    # the realized Poisson rate tracks the target (submission-gap wall
-    # overhead can only LOWER it; a large shortfall would understate load)
-    assert measured["realized_emu_rps"] >= 0.7 * measured["target_rate_rps"]
+    # VERDICT r5 §5: the realized emulated rate must track the benched
+    # target — arrivals are paced on the engine's virtual clock and
+    # under-driving Poisson realizations are redrawn, so a shortfall
+    # beyond 2% means the point validated is easier than promised
+    assert measured["realized_emu_rps"] >= 0.98 * measured["target_rate_rps"]
     assert measured["p99_ttft_ms"] <= bench.SLO_TTFT_MS, measured
     assert measured["meets_slo"] is True
     # the analytic model and the emulator agree on ITL at this point
@@ -134,6 +136,194 @@ def test_measured_p99_meets_slo_at_benched_point(ns):
     doc = json.loads(line)
     assert doc["extra"]["p99_ttft_measured_ms"] == measured["p99_ttft_ms"]
     assert doc["extra"]["p99_meets_slo"] is True
+
+
+def _fake_driver(true_capacity_rps: float, itl_ratio: float):
+    """A closed-form stand-in for the emulator in calibration tests: the
+    'engine' realizes exactly the target rate; its measured ITL is
+    `itl_ratio` x the analytic model's prediction; operating points above
+    `true_capacity_rps` blow the p99 (an unstable queue)."""
+    from inferno_tpu.analyzer import build_analyzer
+    from inferno_tpu.config import (
+        MAX_QUEUE_TO_BATCH_RATIO,
+        DecodeParms,
+        PrefillParms,
+    )
+
+    def drive(prof, rate, seed=0, emu_duration_s=16.0, **kw):
+        analyzer = build_analyzer(
+            max_batch=prof["max_batch"],
+            max_queue=prof["max_batch"] * MAX_QUEUE_TO_BATCH_RATIO,
+            decode=DecodeParms(alpha=prof["alpha"], beta=prof["beta"]),
+            prefill=PrefillParms(gamma=prof["gamma"], delta=prof["delta"]),
+            request=bench.REQ,
+        )
+        stable = rate <= true_capacity_rps
+        try:
+            m = analyzer.analyze(rate)
+            model = {"ttft_ms": m.ttft, "itl_ms": m.avg_token_time,
+                     "rho": m.rho, "concurrency": m.avg_num_in_serv}
+            itl = itl_ratio * m.avg_token_time
+            ttft = m.ttft
+        except Exception as exc:
+            model = {"error": str(exc)}
+            itl, ttft = itl_ratio * 20.0, 50.0
+        p99 = ttft + 20.0 if stable else 5000.0
+        n = int(rate * emu_duration_s)
+        return {
+            "requests": n,
+            "measured_emu_rps_per_replica": rate,
+            "ttft_ms": {"mean": ttft, "p95": p99, "p99": p99},
+            "itl_ms": {"mean": itl},
+            "model": model,
+            "model_error": {"itl_rel": abs(itl_ratio - 1.0)},
+        }
+
+    return drive
+
+
+CAL_PROF = {"alpha": 5.0, "beta": 0.1, "gamma": 2.0, "delta": 0.001,
+            "max_batch": 256, "chips": 4}
+
+
+def test_calibrated_headline_harvests_validated_slack(monkeypatch):
+    """The tentpole closed loop: a 0.88x-conservative model residual
+    activates the corrector, corrected mu(n) re-sizes cheaper, and the
+    (faked) emulator validation accepts a pick below the conservative
+    replica count — block is provenance-marked with the full audit trail."""
+    conservative = bench.usd_per_mtok(
+        bench.DecodeParms(alpha=CAL_PROF["alpha"], beta=CAL_PROF["beta"]),
+        bench.PrefillParms(gamma=CAL_PROF["gamma"], delta=CAL_PROF["delta"]),
+        CAL_PROF["max_batch"], 4 * bench.V5E_CHIP_HR,
+    )
+    lam0 = conservative["rate_per_replica"]
+    monkeypatch.setattr(bench, "_drive_benched_point",
+                        _fake_driver(true_capacity_rps=1.08 * lam0,
+                                     itl_ratio=0.88))
+    cal = bench.calibrated_headline(CAL_PROF, conservative,
+                                    4 * bench.V5E_CHIP_HR, seeds=2)
+    assert cal["provenance"] == "calibrated-emulator"
+    assert cal["harvested"] is True
+    assert cal["replicas"] < conservative["replicas"]
+    assert cal["usd_per_mtok"] < conservative["usd_per_mtok"]
+    assert cal["correction"]["decode_ratio"] == pytest.approx(0.88, rel=0.05)
+    assert cal["validated"]["meets_slo"] is True
+    assert cal["validated"]["realized_emu_rps"] >= (
+        0.98 * cal["validated"]["target_rate_rps"])
+    assert cal["validation_runs"][-1]["accepted"] is True
+    assert cal["observations"] >= 6
+    assert cal["conservative"]["usd_per_mtok"] == pytest.approx(
+        conservative["usd_per_mtok"], rel=1e-3)
+    # the stability contract is documented in the block itself
+    assert "STABILITY_SAFETY_FRACTION" in cal["stability"]["note"]
+    # and the compact line carries the calibrated headline
+    line = bench.compact_line(
+        _NS_STUB, {"platform": "cpu", "auto_selected_ms": 1.0},
+        {"probed": True, "reachable": False}, calibrated=cal)
+    doc = json.loads(line)
+    assert doc["extra"]["calibrated_usd_per_mtok"] == cal["usd_per_mtok"]
+    assert doc["extra"]["calibrated_replicas"] == cal["replicas"]
+
+
+def test_calibrated_headline_in_band_records_finding(monkeypatch):
+    """Residuals inside the calibration band: no correction, and the
+    block says explicitly why nothing was harvested."""
+    conservative = bench.usd_per_mtok(
+        bench.DecodeParms(alpha=CAL_PROF["alpha"], beta=CAL_PROF["beta"]),
+        bench.PrefillParms(gamma=CAL_PROF["gamma"], delta=CAL_PROF["delta"]),
+        CAL_PROF["max_batch"], 4 * bench.V5E_CHIP_HR,
+    )
+    monkeypatch.setattr(
+        bench, "_drive_benched_point",
+        _fake_driver(true_capacity_rps=1e9, itl_ratio=1.0))
+    cal = bench.calibrated_headline(CAL_PROF, conservative,
+                                    4 * bench.V5E_CHIP_HR, seeds=2)
+    assert cal["harvested"] is False
+    assert "band" in cal["finding"]
+    assert "usd_per_mtok" not in cal
+    # an unharvested block still reads as calibration output, not absence
+    line = bench.compact_line(
+        _NS_STUB, {"platform": "cpu", "auto_selected_ms": 1.0},
+        {"probed": True, "reachable": False}, calibrated=cal)
+    assert json.loads(line)["extra"]["calibrated_usd_per_mtok"] is None
+
+
+def test_calibrated_headline_walkback_to_conservative(monkeypatch):
+    """Over-correction whose validation walks all the way back to the
+    conservative pick: harvested=false with the walk-back recorded — the
+    validation gate, not the analytic margin, is the arbiter."""
+    conservative = bench.usd_per_mtok(
+        bench.DecodeParms(alpha=CAL_PROF["alpha"], beta=CAL_PROF["beta"]),
+        bench.PrefillParms(gamma=CAL_PROF["gamma"], delta=CAL_PROF["delta"]),
+        CAL_PROF["max_batch"], 4 * bench.V5E_CHIP_HR,
+    )
+    lam0 = conservative["rate_per_replica"]
+    # big modeled slack (0.7x) but NO real capacity beyond the
+    # conservative rate: every cheaper pick must fail validation
+    monkeypatch.setattr(bench, "_drive_benched_point",
+                        _fake_driver(true_capacity_rps=1.001 * lam0,
+                                     itl_ratio=0.7))
+    cal = bench.calibrated_headline(CAL_PROF, conservative,
+                                    4 * bench.V5E_CHIP_HR, seeds=2)
+    assert cal["harvested"] is False
+    assert "not harvestable" in cal["finding"]
+    # every cheaper pick was MEASURED and rejected — the finding is
+    # backed by the recorded misses, never asserted on an empty list
+    assert cal["validation_runs"]
+    assert all(not run["accepted"] for run in cal["validation_runs"])
+    assert "validated" not in cal
+
+
+def test_calibrated_headline_pessimistic_correction_no_slack(monkeypatch):
+    """Emulator ITL ABOVE the model's: the correction is pessimistic,
+    corrected sizing proposes >= the conservative replicas, and the block
+    says so without fabricating validation evidence (review r6)."""
+    conservative = bench.usd_per_mtok(
+        bench.DecodeParms(alpha=CAL_PROF["alpha"], beta=CAL_PROF["beta"]),
+        bench.PrefillParms(gamma=CAL_PROF["gamma"], delta=CAL_PROF["delta"]),
+        CAL_PROF["max_batch"], 4 * bench.V5E_CHIP_HR,
+    )
+    monkeypatch.setattr(
+        bench, "_drive_benched_point",
+        _fake_driver(true_capacity_rps=1e9, itl_ratio=1.15))
+    cal = bench.calibrated_headline(CAL_PROF, conservative,
+                                    4 * bench.V5E_CHIP_HR, seeds=2)
+    assert cal["harvested"] is False
+    assert cal["correction"]["decode_ratio"] > 1.0
+    assert "pessimistic or evidence-bounded" in cal["finding"]
+    assert "validation_runs" not in cal  # nothing was measured, none claimed
+
+
+_NS_STUB_SHAPE = "v5e-4-int8"
+_NS_STUB = {
+    "chosen_shape": _NS_STUB_SHAPE,
+    "per_shape_provenance": {_NS_STUB_SHAPE: "derived"},
+    "tpu": {"usd_per_mtok": 0.125},
+    "a100": {"usd_per_mtok": 0.1593},
+    "vs_baseline": 1.274,
+}
+
+
+def test_compact_line_degrades_instead_of_raising(monkeypatch):
+    """ADVICE r5: a compact line that outgrows 1024 B must degrade (drop
+    optional extras, relativize the payload pointer) — raising produced
+    ZERO bench output, the exact contract failure the limit guards."""
+    # an absurdly deep checkout path would have blown the old 1024 check
+    monkeypatch.setattr(
+        bench, "FULL_PAYLOAD_PATH",
+        "/very/deep/checkout" * 60 + "/bench_full.json")
+    line = bench.compact_line(
+        _NS_STUB, {"platform": "cpu", "auto_selected_ms": 1.0},
+        {"probed": True, "reachable": False})
+    assert len(line) < 1024
+    doc = json.loads(line)  # still strict JSON
+    # the headline quadruple survives every degradation step
+    assert doc["metric"] == "usd_per_mtok_at_p99_ttft_slo"
+    assert doc["value"] == 0.125
+    assert doc["vs_baseline"] == 1.274
+    # the payload pointer degraded to a repo-relative name, not the
+    # oversized absolute path
+    assert doc["extra"]["full_payload"] == "bench_full.json"
 
 
 def test_llama_70b_multihost_table(ns):

@@ -6,6 +6,8 @@ import json
 import subprocess
 import sys
 
+import pytest
+
 from inferno_tpu.emulator.engine import EmulatedEngine, EngineProfile
 from inferno_tpu.emulator.experiment import (
     Scenario,
@@ -45,8 +47,37 @@ def test_virtual_clock_matches_profile():
     assert abs(observed - predicted) / predicted < 0.25
 
 
+def test_emu_paced_rejects_multi_replica():
+    # the schedule clock is engines[0]: N replicas would silently read
+    # the realized per-replica rate N x high (review r6)
+    with pytest.raises(ValueError, match="single aggregated replica"):
+        run_scenario(_quick_scenario(emu_paced=True, replicas=2))
+
+
+def test_emu_paced_schedule_realizes_target_rate():
+    """Emu-paced arrivals (the bench's benched-point mode) are scheduled
+    on the engine's virtual clock: the realized emulated rate tracks the
+    RateSpec up to Poisson count noise, independent of host overhead —
+    wall-paced schedules drifted 10-30% (VERDICT r5 §5)."""
+    res = run_scenario(_quick_scenario(
+        emu_paced=True,
+        # emu units now: 8 emulated seconds at 50 req/emulated-second
+        rate=RateSpec(((8.0, 50.0),)),
+        time_scale=0.01,
+    ))
+    realized = res["measured_emu_rps_per_replica"]
+    assert 0.85 <= realized / 50.0 <= 1.15  # Poisson noise band, N=400
+    assert res["offered_rps"] == pytest.approx(50.0)
+
+
 def test_model_error_small_in_steady_state():
-    res = run_scenario(_quick_scenario(rate=RateSpec(((2.0, 30.0),))))
+    # emu-paced: the model check compares the analyzer against the
+    # emulated operating point, so the arrival schedule must hold that
+    # point exactly — under wall pacing at extreme compression the
+    # realized emulated rate drifts with host overhead and the
+    # "steady state" lands wherever the host was that day
+    res = run_scenario(_quick_scenario(
+        emu_paced=True, rate=RateSpec(((6.0, 30.0),)), time_scale=0.01))
     assert "model_error" in res
     assert res["model_error"]["itl_rel"] < 0.2
 

@@ -57,6 +57,61 @@ def test_fleet_matches_scalar_candidates():
             assert f_alloc.value == pytest.approx(1.1 * f_alloc.cost, rel=1e-5)
 
 
+def test_fleet_corrected_parms_parity():
+    """Corrector-calibrated profiles flow into ONE SystemSpec consumed by
+    both sizing paths (the reconciler rewrites ModelPerfSpec parms in
+    place): scalar and batched XLA results must agree lane-for-lane on
+    the corrected system exactly as they do on the CR-carried one — the
+    calibration layer must not open a scalar/batched semantic gap."""
+    from inferno_tpu.models.corrector import Observation, ProfileCorrector
+
+    spec = _spec_multi()
+    corrector = ProfileCorrector(use_surrogate=False)
+    # telemetry says the first (model, shape) pair runs 1.6x slower than
+    # its CR profile: the ratio-fallback correction activates and rescales
+    # alpha/beta (and gamma/delta via the TTFT residual)
+    perf = spec.models[0]
+    for i in range(10):
+        conc = 2.0 + i
+        corrector.observe("k", Observation(
+            concurrency=conc, in_tokens=128, out_tokens=128,
+            itl_ms=1.6 * (perf.decode_parms.alpha + perf.decode_parms.beta * conc),
+            ttft_ms=1.6 * (perf.prefill_parms.gamma
+                           + perf.prefill_parms.delta * 128 * conc),
+        ))
+    dec, pre, state = corrector.corrected_parms(
+        "k", perf.decode_parms, perf.prefill_parms
+    )
+    assert state.active and not state.surrogate_used
+    assert dec != perf.decode_parms
+    perf.decode_parms, perf.prefill_parms = dec, pre
+
+    scalar = _scalar_system(spec)
+    fleet = _fleet_system(spec)
+    for name, s_server in scalar.servers.items():
+        f_server = fleet.servers[name]
+        assert set(f_server.all_allocations) == set(s_server.all_allocations), name
+        for acc, s_alloc in s_server.all_allocations.items():
+            f_alloc = f_server.all_allocations[acc]
+            assert abs(f_alloc.num_replicas - s_alloc.num_replicas) <= 1
+            assert f_alloc.max_arrv_rate_per_replica == pytest.approx(
+                s_alloc.max_arrv_rate_per_replica, rel=2e-2
+            )
+            assert f_alloc.itl == pytest.approx(s_alloc.itl, rel=5e-2, abs=0.5)
+            assert f_alloc.ttft == pytest.approx(s_alloc.ttft, rel=5e-2, abs=2.0)
+    # the correction visibly moved the corrected lane's sizing: fewer
+    # sustainable requests per replica on the slowed shape in BOTH paths
+    uncorrected = _scalar_system(_spec_multi())
+    for system in (scalar, fleet):
+        server = system.servers["ns/premium"]
+        base = uncorrected.servers["ns/premium"].all_allocations
+        if spec.models[0].acc in server.all_allocations and spec.models[0].acc in base:
+            assert (
+                server.all_allocations[spec.models[0].acc].max_arrv_rate_per_replica
+                < base[spec.models[0].acc].max_arrv_rate_per_replica
+            )
+
+
 def test_fleet_zero_load_parity():
     spec = make_system_spec([make_server(arrival_rate=0.0, min_replicas=2)])
     scalar = _scalar_system(spec)

@@ -55,6 +55,79 @@ def test_unknown_model_rejected_by_argparse(tmp_path):
     assert "invalid choice" in res.stderr
 
 
+def _load_build_profiles():
+    import importlib.util
+
+    sys.path.insert(0, str(REPO))
+    spec = importlib.util.spec_from_file_location(
+        "build_profiles", REPO / "tools/build_profiles.py")
+    bp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bp)
+    return bp
+
+
+def test_cross_model_resolves_donor_generation_from_meta(tmp_path, monkeypatch):
+    """ADVICE r5: build_cross_model hardcoded the donor raw's source
+    generation as v5e. The recorded meta.device is now authoritative —
+    resolved, stamped into the derivation metadata, and an unresolvable
+    device kind errors out instead of silently rescaling from the wrong
+    hardware baseline."""
+    from tests.test_profiles import fake_raw
+
+    bp = _load_build_profiles()
+    raw = fake_raw()
+    raw["meta"]["device"] = {"kind": "TPU v5 lite", "platform": "tpu"}
+    raw_dir = tmp_path / "raw"
+    raw_dir.mkdir()
+    (raw_dir / "llama-3.1-8b_tpu_int8.json").write_text(json.dumps(raw))
+    monkeypatch.setattr(bp, "RAW_DIR", raw_dir)
+
+    built = bp.build_cross_model("llama-3.1-70b")
+    doc = built["llama-3.1-70b_v5e-16-int8.json"]
+    assert doc["assumptions"]["cross_model"]["donor_generation"] == "v5e"
+    # same-generation target: no cross-generation assumption stacked
+    assert "cross_generation" not in doc["assumptions"]
+    # cross-generation target records the resolved source, not a constant
+    v6e = built["llama-3.1-70b_v6e-16-int8.json"]
+    assert v6e["assumptions"]["cross_generation"]["source_generation"] == "v5e"
+
+
+def test_cross_model_errors_on_unresolvable_donor_device(tmp_path, monkeypatch):
+    import pytest
+
+    from tests.test_profiles import fake_raw
+
+    bp = _load_build_profiles()
+    raw = fake_raw()
+    raw["meta"]["device"] = {"kind": "TPU v9 hyper", "platform": "tpu"}
+    raw_dir = tmp_path / "raw"
+    raw_dir.mkdir()
+    (raw_dir / "llama-3.1-8b_tpu_int8.json").write_text(json.dumps(raw))
+    monkeypatch.setattr(bp, "RAW_DIR", raw_dir)
+
+    with pytest.raises(SystemExit, match="cannot resolve TPU generation"):
+        bp.build_cross_model("llama-3.1-70b")
+
+
+def test_build_model_rejects_non_v5e_measured_raw(tmp_path, monkeypatch):
+    """build_model's emitted names and TP derivations anchor on v5e; a
+    raw recorded on another generation must error, not mis-label."""
+    import pytest
+
+    from tests.test_profiles import fake_raw
+
+    bp = _load_build_profiles()
+    raw = fake_raw()
+    raw["meta"]["device"] = {"kind": "TPU v5p", "platform": "tpu"}
+    raw_dir = tmp_path / "raw"
+    raw_dir.mkdir()
+    (raw_dir / "llama-3.1-8b_tpu_int8.json").write_text(json.dumps(raw))
+    monkeypatch.setattr(bp, "RAW_DIR", raw_dir)
+
+    with pytest.raises(SystemExit, match="measured on v5p"):
+        bp.build_model("llama-3.1-8b")
+
+
 def test_build_profiles_quarantines_memory_infeasible_int8(tmp_path, monkeypatch):
     """ADVICE r3: an int8 raw that does not fit one chip must never be
     published as the headline v5e-1 profile — it is quarantined under

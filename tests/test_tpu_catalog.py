@@ -121,3 +121,17 @@ def dataclasses_error():
     import dataclasses
 
     return dataclasses.FrozenInstanceError
+
+
+def test_generation_from_device_kind():
+    from inferno_tpu.config.tpu_catalog import generation_from_device_kind
+
+    # jax device_kind strings as recorded by tools/profile_tpu.py
+    assert generation_from_device_kind("TPU v5 lite").name == "v5e"
+    assert generation_from_device_kind("TPU v5p").name == "v5p"
+    assert generation_from_device_kind("TPU v5").name == "v5p"
+    assert generation_from_device_kind("TPU v6 lite").name == "v6e"
+    assert generation_from_device_kind("TPU v6e").name == "v6e"
+    assert generation_from_device_kind("Trillium").name == "v6e"
+    with pytest.raises(ValueError, match="cannot resolve"):
+        generation_from_device_kind("TPU v9 hyper")

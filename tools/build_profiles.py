@@ -26,7 +26,10 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from inferno_tpu.config.tpu_catalog import TPU_GENERATIONS
+from inferno_tpu.config.tpu_catalog import (
+    TPU_GENERATIONS,
+    generation_from_device_kind,
+)
 from inferno_tpu.models.llama_block import MODEL_PRESETS
 from inferno_tpu.models.profiles import (
     PROFILES_DIR,
@@ -38,6 +41,24 @@ from inferno_tpu.models.profiles import (
 )
 
 RAW_DIR = PROFILES_DIR / "raw"
+
+
+def raw_source_generation(raw: dict, raw_name: str):
+    """The TPU generation a raw sweep was MEASURED on, resolved from its
+    recorded meta.device (ADVICE r5: the cross-model path hardcoded v5e,
+    so a donor measured on another generation would have been silently
+    rescaled from the wrong hardware baseline). A recorded device kind is
+    authoritative and errors out when unresolvable; raws predating the
+    device-meta convention (no meta.device) were all measured on the v5e
+    dev chip and default to it."""
+    device = (raw.get("meta") or {}).get("device") or {}
+    kind = str(device.get("kind", ""))
+    if not kind:
+        return TPU_GENERATIONS["v5e"]
+    try:
+        return generation_from_device_kind(kind)
+    except ValueError as e:
+        raise SystemExit(f"{raw_name}: {e}")
 
 # Cross-generation shapes derived from the v5e measurement by hardware
 # ratios (HBM bandwidth for decode, bf16 FLOPs for prefill — see
@@ -84,6 +105,20 @@ def build_model(model: str) -> dict[str, dict]:
     raw_int8 = json.loads(int8_path.read_text()) if int8_path.exists() else None
     if raw_bf16 is None and raw_int8 is None:
         raise SystemExit(f"no raw measurements for {model} under {RAW_DIR}")
+    # every emitted profile name anchors on v5e ("v5e-1", "v5e-4", ...)
+    # and the cross-generation rescale below uses v5e as its source
+    # constants: verify the raws were actually measured there instead of
+    # assuming it (the recorded meta.device is authoritative)
+    for raw, nm in ((raw_bf16, bf16_path.name), (raw_int8, int8_path.name)):
+        if raw is None:
+            continue
+        src_gen = raw_source_generation(raw, nm)
+        if src_gen.name != "v5e":
+            raise SystemExit(
+                f"{nm}: measured on {src_gen.name} (meta.device), but the "
+                "emitted profile names and TP derivations anchor on v5e — "
+                "re-profile on v5e or extend build_model's naming"
+            )
 
     ctx_bf16 = context_raws(model, "")
     ctx_int8 = context_raws(model, "_int8")
@@ -180,18 +215,23 @@ def build_cross_model(model: str) -> dict[str, dict]:
             continue
         donor_raw = json.loads(donor_path.read_text())
         raw = rescale_raw_cross_model(donor_raw, dst_dims, model)
+        # the generation the donor sweep was MEASURED on, from its
+        # recorded meta.device — target shapes on the same generation
+        # need no hardware rescale; every other generation rescales from
+        # the donor's actual baseline (errors on unresolvable device)
+        src = raw_source_generation(donor_raw, donor_path.name)
         cm_meta = {
             "donor_model": donor,
             "donor_raw": donor_path.name,
+            "donor_generation": src.name,
             "method": "per-layer bytes/FLOPs rescale of the measured "
                       "donor sweep (rescale_raw_cross_model)",
         }
-        src = TPU_GENERATIONS["v5e"]
         for gen_name, chips in cfg["shapes"]:
             dst = TPU_GENERATIONS[gen_name]
-            gen_raw = raw if gen_name == "v5e" else rescale_raw_cross_generation(
+            gen_raw = raw if gen_name == src.name else rescale_raw_cross_generation(
                 raw, src, dst)
-            cross_gen = None if gen_name == "v5e" else {
+            cross_gen = None if gen_name == src.name else {
                 "source_generation": src.name,
                 "target_generation": dst.name,
                 "hbm_bw_scale": round(dst.hbm_bw_gbs / src.hbm_bw_gbs, 3),
