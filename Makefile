@@ -6,7 +6,8 @@ IMG ?= inferno-tpu-autoscaler:latest
 CLUSTER ?= inferno-tpu
 
 .PHONY: all test test-unit test-e2e test-apiserver bench native lint \
-        manifests-sync docker-build deploy-kind deploy undeploy clean
+        lint-metrics manifests-sync docker-build deploy-kind deploy \
+        undeploy clean
 
 all: native test
 
@@ -45,6 +46,11 @@ native:
 
 lint:
 	$(PYTHON) -m compileall -q inferno_tpu tests
+
+# Metric-catalog lint: every registered series needs non-empty help text
+# and the inferno_ name prefix (also enforced by tests/test_metrics_lint.py).
+lint-metrics:
+	$(PYTHON) -m inferno_tpu.obs.lint
 
 # Keep the Helm chart's CRD copy identical to the canonical manifest.
 manifests-sync:

@@ -1013,11 +1013,16 @@ def service_rate_ceiling(decode, prefill, max_batch: int) -> float:
 
 def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
                        measured_p99: dict | None = None,
-                       calibrated: dict | None = None) -> dict:
+                       calibrated: dict | None = None,
+                       trace: dict | None = None) -> dict:
     """Everything the bench measures, in one document — written to
     `bench_full.json`, NOT printed (the printed line is `compact_line`)."""
     return {
         **({"measured_p99": measured_p99} if measured_p99 else {}),
+        # span trace of the bench run itself (obs/trace.py): which phase
+        # ate the wall-clock — probe, sizing sweep, emulator drive,
+        # calibration ladder, or fleet-cycle timing
+        **({"trace": trace} if trace else {}),
         # the closed-loop calibration harvest, provenance-marked: sits
         # NEXT TO the conservative headline (metric/value below), never
         # replaces it — `calibrated.harvested` says whether the corrected
@@ -1147,21 +1152,37 @@ def main() -> None:
     ap.add_argument("--quick", action="store_true",
                     help="skip the 4096-lane scaling row (CI smoke)")
     args = ap.parse_args()
-    tpu_probe = _pin_cpu_if_tpu_unreachable()
-    ns = north_star()
-    measured = measured_p99_at_benched_point(ns)
+    from inferno_tpu.obs import Tracer
+
+    tracer = Tracer("bench")
+    with tracer.span("tpu-probe"):
+        tpu_probe = _pin_cpu_if_tpu_unreachable()
+    with tracer.span("north-star-sizing"):
+        ns = north_star()
+    with tracer.span("measured-p99"):
+        measured = measured_p99_at_benched_point(ns)
     # closed-loop calibration at the benched point: --quick runs a 2-seed
     # ladder (8 observations — exercises the corrector's ratio-fallback
     # path), the full bench a 3-seed ladder (12 — surrogate-eligible)
     prof = ns["profile"]
-    calibrated = calibrated_headline(
-        prof, ns["tpu"], prof["chips"] * V5E_CHIP_HR,
-        seeds=2 if args.quick else 3,
-    )
-    cycles = fleet_cycle_metrics(full=not args.quick)
+    with tracer.span("calibration-ladder", seeds=2 if args.quick else 3) as sp:
+        # guarded like the pallas block: a calibration failure (emulator
+        # thread regression, surrogate refit error) is a finding to
+        # record, never a reason to abort before the headline prints
+        try:
+            calibrated = calibrated_headline(
+                prof, ns["tpu"], prof["chips"] * V5E_CHIP_HR,
+                seeds=2 if args.quick else 3,
+            )
+        except Exception as e:  # noqa: BLE001 — artifact must survive
+            calibrated = {"harvested": False, "error": f"{type(e).__name__}: {e}"}
+            sp.set(error=str(e))
+    with tracer.span("fleet-cycle-timing"):
+        cycles = fleet_cycle_metrics(full=not args.quick)
     Path(FULL_PAYLOAD_PATH).write_text(
         json.dumps(build_full_payload(ns, cycles, tpu_probe, measured,
-                                      calibrated),
+                                      calibrated,
+                                      trace=tracer.finish().to_dict()),
                    indent=1) + "\n"
     )
     print(compact_line(ns, cycles, tpu_probe, measured, calibrated))

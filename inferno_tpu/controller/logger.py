@@ -43,7 +43,12 @@ class JsonFormatter(logging.Formatter):
         if fields:
             out.update(fields)
         if record.exc_info and record.exc_info[0] is not None:
-            out["error"] = self.formatException(record.exc_info)
+            # structured split (zap's error/stacktrace convention): `error`
+            # is the one-line "Type: message" a log query can match on;
+            # `stack` carries the full traceback instead of dropping it
+            etype, evalue, _ = record.exc_info
+            out["error"] = f"{etype.__name__}: {evalue}"
+            out["stack"] = self.formatException(record.exc_info)
         return json.dumps(out, default=str)
 
 

@@ -38,6 +38,10 @@ controller.go:516-582):
                                 pin of each variant to its current slice
                                 shape; false allows economic migration
                                 between shapes)
+  DECISION_TRACE_BUFFER         how many recent reconcile-cycle traces the
+                                metrics listener retains for
+                                /debug/decisions (default 32;
+                                docs/observability.md)
 """
 
 from __future__ import annotations
@@ -103,14 +107,19 @@ def main() -> int:
         return 1
 
     from inferno_tpu.controller.metrics import TLSConfig
+    from inferno_tpu.obs import TraceBuffer
 
     kube = RestKubeClient()
     registry = Registry()
     emitter = MetricsEmitter(registry)
+    # last-K reconcile-cycle traces + decision records, shared between the
+    # reconciler (writer) and the metrics listener (/debug/decisions)
+    traces = TraceBuffer(capacity=int(os.environ.get("DECISION_TRACE_BUFFER", "32")))
     server = MetricsServer(
         registry,
         port=int(os.environ.get("METRICS_PORT", "8443")),
         tls=TLSConfig.from_env(),
+        traces=traces,
     )
     server.start()
     # dedicated probe port so liveness/readiness don't ride the metrics
@@ -129,7 +138,12 @@ def main() -> int:
         profile_correction=env_bool("PROFILE_CORRECTION", True),
         keep_accelerator=env_bool("KEEP_ACCELERATOR", True),
     )
-    rec = Reconciler(kube=kube, prom=prom, config=config, emitter=emitter)
+    rec = Reconciler(
+        kube=kube, prom=prom, config=config, emitter=emitter, trace_buffer=traces
+    )
+    # readiness heartbeat: both probe listeners share this dict, so a
+    # reconcile loop that stops cycling (> 3x interval) fails /readyz
+    rec.ready_flag = server.ready_flag
 
     stopping = {"stop": False}
 

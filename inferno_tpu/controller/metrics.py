@@ -2,15 +2,21 @@
 
 Minimal stdlib registry (the actuation contract is just four series,
 reference: internal/metrics/metrics.go:20-65): gauges + a counter with
-labels, rendered in the text exposition format and served over HTTP
-together with health probes (reference serves these via
-controller-runtime, cmd/main.go:157-169, 250-257).
+labels, plus a text-exposition histogram kind (`_bucket`/`_sum`/`_count`)
+for the cycle-latency instrumentation (ISSUE-3), rendered in the text
+exposition format and served over HTTP together with health probes
+(reference serves these via controller-runtime, cmd/main.go:157-169,
+250-257). The metrics listener also exposes `/debug/decisions` — the
+last-K reconcile-cycle traces with their per-variant DecisionRecords —
+when given a TraceBuffer.
 """
 
 from __future__ import annotations
 
 import http.server
+import json
 import threading
+import time
 from typing import Iterable
 
 from inferno_tpu.controller.engines import (
@@ -66,24 +72,123 @@ class _Series:
             yield f"{self.name}{_fmt_labels(labels)} {value}"
 
 
+# Latency bucket boundaries in seconds, sized for the cycle's observed
+# dynamic range: sub-ms scalar sizing of one variant up through multi-
+# second full-fleet cycles on a cold XLA cache.
+LATENCY_BUCKETS_S = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt_le(bound: float) -> str:
+    """Prometheus renders integral bounds without a trailing .0."""
+    return str(int(bound)) if float(bound).is_integer() else repr(bound)
+
+
+class _Histogram:
+    """Cumulative-bucket histogram in the text exposition format: per
+    label set, `name_bucket{...,le="b"}` lines (cumulative, ending at
+    +Inf), plus `name_sum` and `name_count`."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str, buckets: tuple[float, ...]):
+        if not buckets or tuple(sorted(buckets)) != tuple(buckets):
+            raise ValueError(f"buckets must be sorted and non-empty: {buckets}")
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(float(b) for b in buckets)
+        # label key -> (labels, per-bucket counts (non-cumulative), sum, count)
+        self.values: dict[tuple, tuple[dict[str, str], list[int], float, int]] = {}
+
+    def _key(self, labels: dict[str, str]) -> tuple:
+        return tuple(sorted(labels.items()))
+
+    def observe(self, labels: dict[str, str], value: float) -> None:
+        key = self._key(labels)
+        entry = self.values.get(key)
+        if entry is None:
+            entry = (dict(labels), [0] * (len(self.buckets) + 1), 0.0, 0)
+        lbls, counts, total, n = entry
+        # copy-on-write: a concurrent /metrics render snapshots the stored
+        # tuples, so mutating the shared counts list in place could show a
+        # finite bucket ahead of _count (+Inf) — an invalid cumulative
+        # exposition. A fresh list + atomic dict assignment keeps every
+        # rendered view internally consistent (old or new, never mixed).
+        counts = list(counts)
+        # last slot is the +Inf overflow bucket
+        idx = next(
+            (i for i, b in enumerate(self.buckets) if value <= b),
+            len(self.buckets),
+        )
+        counts[idx] += 1
+        self.values[key] = (lbls, counts, total + value, n + 1)
+
+    def remove(self, labels: dict[str, str]) -> None:
+        self.values.pop(self._key(labels), None)
+
+    def labelsets(self) -> list[dict[str, str]]:
+        """Snapshot of the label sets with observations (pruning support)."""
+        return [dict(lbls) for lbls, *_ in list(self.values.values())]
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        # snapshot: observe/remove run on the reconcile thread while
+        # /metrics scrapes render here
+        for labels, counts, total, n in list(self.values.values()):
+            cum = 0
+            for bound, c in zip(self.buckets, counts):
+                cum += c
+                le = {**labels, "le": _fmt_le(bound)}
+                yield f"{self.name}_bucket{_fmt_labels(le)} {cum}"
+            inf = {**labels, "le": "+Inf"}
+            yield f"{self.name}_bucket{_fmt_labels(inf)} {n}"
+            yield f"{self.name}_sum{_fmt_labels(labels)} {total}"
+            yield f"{self.name}_count{_fmt_labels(labels)} {n}"
+
+
 class Registry:
     def __init__(self):
-        self._series: dict[str, _Series] = {}
+        self._series: dict[str, _Series | _Histogram] = {}
         self._lock = threading.Lock()
 
     def gauge(self, name: str, help_: str = "") -> _Series:
-        return self._get(name, help_, "gauge")
+        return self._get(name, "gauge", lambda: _Series(name, help_, "gauge"))
 
     def counter(self, name: str, help_: str = "") -> _Series:
-        return self._get(name, help_, "counter")
+        return self._get(name, "counter", lambda: _Series(name, help_, "counter"))
 
-    def _get(self, name: str, help_: str, kind: str) -> _Series:
+    def histogram(
+        self,
+        name: str,
+        help_: str = "",
+        buckets: tuple[float, ...] = LATENCY_BUCKETS_S,
+    ) -> _Histogram:
+        # NOTE: a repeat registration returns the existing instance; like
+        # help text, a differing `buckets` argument on the second call is
+        # ignored (first registration wins)
+        return self._get(name, "histogram", lambda: _Histogram(name, help_, buckets))
+
+    def _get(self, name: str, kind: str, make):
+        """Single register-or-fetch path for every series kind: the name
+        is the identity, and re-registering under a different kind is a
+        hard error, never a silent alias."""
         with self._lock:
             s = self._series.get(name)
             if s is None:
-                s = _Series(name, help_, kind)
+                s = make()
                 self._series[name] = s
+            if s.kind != kind:
+                raise ValueError(f"{name} is already registered as a {s.kind}")
             return s
+
+    def catalog(self) -> list[tuple[str, str, str]]:
+        """(name, help, kind) of every registered series — the lint and
+        documentation surface (obs/lint.py, docs/observability.md)."""
+        with self._lock:
+            return [(s.name, s.help, s.kind) for s in self._series.values()]
 
     def render(self) -> str:
         with self._lock:
@@ -170,6 +275,63 @@ class MetricsEmitter:
                 continue
             ns, variant = key
             self._drop_gauges(ns, variant, self._last_accelerator.pop(key))
+
+
+# Cycle-latency histogram names (ISSUE-3 tentpole). All carry the
+# inferno_ prefix asserted by `make lint-metrics` (obs/lint.py).
+METRIC_CYCLE_DURATION = "inferno_cycle_duration_seconds"
+METRIC_VARIANT_ANALYSIS = "inferno_variant_analysis_seconds"
+METRIC_SOLVER_LATENCY = "inferno_solver_seconds"
+METRIC_PROM_SCRAPE = "inferno_prom_scrape_seconds"
+
+
+class CycleInstruments:
+    """Latency histograms for the reconcile loop: whole-cycle duration,
+    per-variant analysis (prepare) latency, assignment-solver latency,
+    and Prometheus scrape latency. The per-variant analysis series is
+    labeled (namespace, variant_name) and therefore participates in the
+    deleted-variant pruning the gauges already get — frozen latency
+    series of dead variants would misrepresent the fleet's percentiles
+    forever (histogram buckets only ever grow)."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self.cycle = self.registry.histogram(
+            METRIC_CYCLE_DURATION, "Reconcile cycle wall-clock duration"
+        )
+        self.analysis = self.registry.histogram(
+            METRIC_VARIANT_ANALYSIS,
+            "Per-variant analysis (prepare) latency within a cycle",
+        )
+        self.solver = self.registry.histogram(
+            METRIC_SOLVER_LATENCY, "Allocation assignment solver latency"
+        )
+        self.scrape = self.registry.histogram(
+            METRIC_PROM_SCRAPE,
+            "Prometheus query latency for load/metrics collection",
+        )
+
+    def observe_cycle(self, seconds: float) -> None:
+        self.cycle.observe({}, seconds)
+
+    def observe_analysis(self, namespace: str, variant: str, seconds: float) -> None:
+        self.analysis.observe(
+            {LABEL_OUT_NAMESPACE: namespace, LABEL_VARIANT: variant}, seconds
+        )
+
+    def observe_solver(self, seconds: float) -> None:
+        self.solver.observe({}, seconds)
+
+    def observe_scrape(self, seconds: float) -> None:
+        self.scrape.observe({}, seconds)
+
+    def prune_variants(self, active: set[tuple[str, str]]) -> None:
+        """Drop per-variant analysis series of variants no longer managed
+        (same contract as MetricsEmitter.prune_variants)."""
+        for labels in self.analysis.labelsets():
+            key = (labels.get(LABEL_OUT_NAMESPACE, ""), labels.get(LABEL_VARIANT, ""))
+            if key not in active:
+                self.analysis.remove(labels)
 
 
 class TLSConfig:
@@ -283,8 +445,29 @@ class _RouteServer:
 
 def _probe_routes(ready_flag: dict) -> dict:
     def readyz():
-        ok = ready_flag["ready"]
-        return (200, None, b"ok") if ok else (503, None, b"not ready")
+        if not ready_flag["ready"]:
+            return (503, None, b"not ready")
+        # Stale-controller detection: the reconciler heartbeats
+        # `last_cycle_monotonic` after every cycle (and while idling as a
+        # non-leader standby) and publishes the freshness budget as
+        # `max_cycle_age_s` (3x the configured interval). A loop that
+        # stopped cycling — deadlocked solver, hung Kube/Prom client —
+        # fails readiness: the condition surfaces in `kubectl get pods`
+        # and alerts instead of silently freezing the fleet at its last
+        # decision. (Readiness alone does not restart the pod; operators
+        # who want that wire the livenessProbe to /readyz, trading
+        # restarts for standby safety.) Monotonic clock: wall steps must
+        # not fake staleness. Before the first cycle completes there is
+        # no heartbeat and no verdict — startup is governed by `ready`.
+        last = ready_flag.get("last_cycle_monotonic")
+        max_age = ready_flag.get("max_cycle_age_s", 0)
+        if last is not None and max_age > 0:
+            age = time.monotonic() - last
+            if age > max_age:
+                return (503, None,
+                        f"stale: last reconcile cycle {age:.0f}s ago "
+                        f"(budget {max_age:.0f}s)".encode())
+        return (200, None, b"ok")
 
     return {"/healthz": lambda: (200, None, b"ok"), "/readyz": readyz}
 
@@ -292,7 +475,8 @@ def _probe_routes(ready_flag: dict) -> dict:
 class HealthServer(_RouteServer):
     """/healthz + /readyz on the dedicated probe port (reference serves
     probes on their own port, cmd/main.go:250-257; the manager Deployment
-    probes :8081)."""
+    probes :8081). Readiness additionally fails when the reconcile loop's
+    heartbeat goes stale — see _probe_routes."""
 
     def __init__(self, ready_flag: dict, port: int = 8081, host: str = ""):
         super().__init__(_probe_routes(ready_flag), port, host)
@@ -300,7 +484,10 @@ class HealthServer(_RouteServer):
 
 class MetricsServer(_RouteServer):
     """Serves /metrics (plus the probe routes, for single-port setups) on
-    a background thread."""
+    a background thread. Given a TraceBuffer, also serves
+    /debug/decisions: the last-K reconcile-cycle traces, each carrying
+    its per-variant DecisionRecords — the operator's "why did replicas
+    jump?" endpoint (docs/observability.md)."""
 
     def __init__(
         self,
@@ -308,12 +495,24 @@ class MetricsServer(_RouteServer):
         port: int = 8443,
         host: str = "",
         tls: TLSConfig | None = None,
+        traces=None,  # obs.TraceBuffer
     ):
         self.registry = registry
+        self.traces = traces
         self.ready_flag = {"ready": True}
 
         def metrics():
             return (200, "text/plain; version=0.0.4", registry.render().encode())
 
         routes = {"/metrics": metrics, **_probe_routes(self.ready_flag)}
+        if traces is not None:
+
+            def decisions():
+                body = json.dumps(
+                    {"capacity": traces.capacity, "cycles": traces.snapshot()},
+                    default=str,
+                )
+                return (200, "application/json", body.encode())
+
+            routes["/debug/decisions"] = decisions
         super().__init__(routes, port, host, tls=tls)

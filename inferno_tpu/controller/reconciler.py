@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import threading
 import time
 from typing import Any, Callable
@@ -56,8 +57,21 @@ from inferno_tpu.controller.engines import EngineMetrics, engine_for
 from inferno_tpu.controller.inventory import collect_tpu_inventory
 from inferno_tpu.controller.kube import KubeClient, KubeError, NotFound
 from inferno_tpu.controller.workload import get_workload
+from inferno_tpu.controller.logger import kv
 from inferno_tpu.controller.promclient import PromClient, PromError
 from inferno_tpu.core import System
+from inferno_tpu.obs import (
+    PROVENANCE_CORRECTED,
+    REASON_ASLEEP,
+    REASON_CAPACITY_LIMITED,
+    REASON_COST_BOUND,
+    REASON_ERROR,
+    REASON_SLO_BOUND,
+    DecisionRecord,
+    Span,
+    TraceBuffer,
+    Tracer,
+)
 from inferno_tpu.solver import Optimizer
 
 DEFAULT_INTERVAL_SECONDS = 60  # reference: variantautoscaling_controller.go:94-101
@@ -183,6 +197,13 @@ class CycleReport:
     solver_ms: float = 0.0
     analysis_ms: float = 0.0
     errors: list[str] = dataclasses.field(default_factory=list)
+    # one DecisionRecord per VA seen this cycle (obs/decision.py): the
+    # per-variant sizing rationale — observed λ, provenance, λ_max, SLO
+    # headroom, chosen shape/replicas, cost delta, and a reason code
+    decisions: list[DecisionRecord] = dataclasses.field(default_factory=list)
+    # root span of the cycle trace (obs/trace.py): collect -> analyze
+    # (one child per variant) -> solve -> actuate
+    trace: Span | None = None
 
 
 class Reconciler:
@@ -192,8 +213,9 @@ class Reconciler:
         prom: PromClient,
         config: ReconcilerConfig | None = None,
         emitter=None,
+        trace_buffer: TraceBuffer | None = None,
     ):
-        from inferno_tpu.controller.metrics import MetricsEmitter
+        from inferno_tpu.controller.metrics import CycleInstruments, MetricsEmitter
 
         from inferno_tpu.controller.logger import get_logger
 
@@ -201,6 +223,17 @@ class Reconciler:
         self.prom = prom
         self.config = config or ReconcilerConfig()
         self.emitter = emitter or MetricsEmitter()
+        # cycle-latency histograms share the emitter's registry so one
+        # /metrics listener exposes the whole catalog
+        self.instruments = CycleInstruments(self.emitter.registry)
+        # ring of recent cycle traces, served at /debug/decisions when
+        # main() hands the same buffer to the MetricsServer (identity
+        # check: an EMPTY shared buffer is falsy — len() == 0 — and `or`
+        # would silently disconnect it)
+        self.traces = trace_buffer if trace_buffer is not None else TraceBuffer()
+        # readiness heartbeat (metrics._probe_routes): run_cycle stamps
+        # last_cycle_monotonic + max_cycle_age_s into this dict when set
+        self.ready_flag: dict | None = None
         self.actuator = Actuator(
             kube=kube, emitter=self.emitter, direct_scale=self.config.direct_scale
         )
@@ -410,12 +443,25 @@ class Reconciler:
     ) -> bool:
         """Prepare one VA into the system spec
         (reference prepareVariantAutoscalings: controller.go:218-335).
-        Returns True if the VA was added as a server."""
+        Returns True if the VA was added as a server. Every call appends a
+        DecisionRecord to the report — partial (reason `error` + detail)
+        when preparation fails, completed by _apply once a decision
+        exists."""
+        rec = DecisionRecord(
+            variant=va.full_name,
+            namespace=va.namespace,
+            name=va.name,
+            model=va.spec.model_id,
+        )
+        report.decisions.append(rec)
         slo = self._find_slo(classes, va)
         if slo is None:
+            rec.detail = f"no SLO entry for model {va.spec.model_id}"
             report.errors.append(f"{va.full_name}: no SLO entry for model {va.spec.model_id}")
             return False
         class_name, target = slo
+        rec.slo_ttft_ms = target.slo_ttft
+        rec.slo_itl_ms = target.slo_itl
 
         # Perf data registers under a per-variant model key: the registry is
         # keyed (model, acc) with last-wins semantics, so two variants
@@ -437,19 +483,25 @@ class Reconciler:
         # select the bucket matching the observed average input length
         matching_profiles = [p for p in va.spec.accelerators if p.acc in accelerators]
         if not matching_profiles:
+            rec.detail = "no profile matches a known slice shape"
             report.errors.append(f"{va.full_name}: no profile matches a known slice shape")
             return False
 
         try:
             wl = get_workload(self.kube, va.namespace, va.name)
         except KubeError as e:
+            rec.detail = f"workload: {e}"
             report.errors.append(f"{va.full_name}: workload: {e}")
             return False
         self._set_owner_reference(va, wl)
 
-        validation = validate_metrics_availability(
-            self.prom, engine, va.spec.model_id, va.namespace
-        )
+        scrape_t0 = time.perf_counter()
+        try:
+            validation = validate_metrics_availability(
+                self.prom, engine, va.spec.model_id, va.namespace
+            )
+        finally:
+            self.instruments.observe_scrape(time.perf_counter() - scrape_t0)
         # Scaled-to-zero is ASLEEP, not broken (the metric-series
         # stranding hazard): at 0 replicas every engine series died with
         # the pods, so MetricsMissing is the EXPECTED state — skipping
@@ -476,7 +528,9 @@ class Reconciler:
                 if asleep else ""
             ),
         )
+        rec.asleep = asleep
         if not validation.available and not asleep:
+            rec.detail = f"metrics unavailable ({validation.reason}); variant skipped"
             va.status.set_condition(
                 TYPE_OPTIMIZATION_READY,
                 "False",
@@ -500,15 +554,25 @@ class Reconciler:
         prof = next((p for p in va.spec.accelerators if p.acc == acc_name), None)
         if prof is not None:
             cost *= prof.acc_count * (prof.disagg.slices_per_unit if prof.disagg else 1)
+        scrape_t0 = time.perf_counter()
         try:
             if asleep:
                 current = collect_sleeping_alloc(self.prom, engine, va, wl)
             else:
                 current = collect_current_alloc(self.prom, engine, va, wl, cost)
         except PromError as e:
+            rec.detail = f"collect: {e}"
             report.errors.append(f"{va.full_name}: collect: {e}")
             return False
+        finally:
+            self.instruments.observe_scrape(time.perf_counter() - scrape_t0)
         va.status.current_alloc = current
+        rec.arrival_rpm = current.load.arrival_rate
+        rec.ttft_observed_ms = current.ttft_average
+        rec.itl_observed_ms = current.itl_average
+        rec.prev_accelerator = current.accelerator
+        rec.prev_replicas = current.num_replicas
+        rec.prev_cost = current.variant_cost
 
         # profile correction: feed this cycle's observation, compute the
         # current slice shape's corrected parms once, and carry the
@@ -546,6 +610,7 @@ class Reconciler:
                 )
                 if corr_state.active:
                     report.corrections_active += 1
+                    rec.profile_provenance = PROVENANCE_CORRECTED
                     self.log.info(
                         "profile correction active for %s: decode x%.2f "
                         "prefill x%.2f (surrogate=%s, %d obs)",
@@ -606,28 +671,59 @@ class Reconciler:
     # -- the cycle ----------------------------------------------------------
 
     def run_cycle(self) -> CycleReport:
-        report = CycleReport(interval_seconds=self.read_interval())
-        engine = engine_for(self.config.engine)
-
-        accelerators = {a.name: a for a in self.read_accelerators()}
-        classes = self.read_service_classes()
-        optimizer_spec, capacity = self.read_optimizer_and_capacity()
-
+        """One reconcile cycle. The returned report carries a span trace
+        (collect -> analyze -> solve -> actuate) and one DecisionRecord
+        per variant seen; both are also retained on the trace ring buffer
+        for /debug/decisions and emitted as structured log events."""
+        tracer = Tracer("reconcile-cycle")
+        report = CycleReport(interval_seconds=self.config.interval_seconds)
         try:
-            vas = [va for va in self.kube.list_variant_autoscalings() if va.active]
-        except KubeError as e:
-            report.errors.append(f"list: {e}")
-            report.optimization_ok = False
-            return report
-        report.variants_seen = len(vas)
-        # deleted variants: drop their telemetry state and gauge series
-        # (leaving frozen gauges would keep external actuators acting on a
-        # variant that no longer exists)
-        self.emitter.prune_variants({(va.namespace, va.name) for va in vas})
-        if self.corrector is not None:
-            self.corrector.prune({va.full_name for va in vas})
+            self._cycle(tracer, report)
+        finally:
+            # every exit path — happy, early-return, raise — finishes the
+            # trace, records the cycle histogram, and publishes the
+            # heartbeat; an unexplainable cycle is the bug this PR removes
+            self._finish_cycle(tracer, report)
+        return report
+
+    def _cycle(self, tracer: Tracer, report: CycleReport) -> None:
+        with tracer.span("collect") as sp:
+            engine = engine_for(self.config.engine)
+            try:
+                # _read_cm absorbs NotFound only; a transient apiserver
+                # 500/timeout must be recorded and retried next cycle like
+                # the VA-list failure below, never crash run_forever (the
+                # staleness heartbeat assumes the loop survives errors)
+                report.interval_seconds = self.read_interval()
+                accelerators = {a.name: a for a in self.read_accelerators()}
+                classes = self.read_service_classes()
+                optimizer_spec, capacity = self.read_optimizer_and_capacity()
+            except KubeError as e:
+                report.errors.append(f"config: {e}")
+                report.optimization_ok = False
+                sp.set(error=str(e))
+                return
+
+            try:
+                vas = [va for va in self.kube.list_variant_autoscalings() if va.active]
+            except KubeError as e:
+                report.errors.append(f"list: {e}")
+                report.optimization_ok = False
+                sp.set(error=str(e))
+                return
+            report.variants_seen = len(vas)
+            sp.set(variants_seen=len(vas), accelerators=len(accelerators))
+            # deleted variants: drop their telemetry state, gauge series,
+            # and per-variant latency-histogram series (leaving frozen
+            # gauges would keep external actuators acting on a variant
+            # that no longer exists)
+            active = {(va.namespace, va.name) for va in vas}
+            self.emitter.prune_variants(active)
+            self.instruments.prune_variants(active)
+            if self.corrector is not None:
+                self.corrector.prune({va.full_name for va in vas})
         if not vas:
-            return report
+            return
 
         spec = SystemSpec(
             accelerators=list(accelerators.values()),
@@ -636,64 +732,130 @@ class Reconciler:
             capacity=capacity,
         )
         prepared: list[VariantAutoscaling] = []
-        for va in vas:
-            if self.prepare(va, engine, classes, accelerators, spec, report):
-                prepared.append(va)
+        with tracer.span("analyze") as sp:
+            for va in vas:
+                t0 = time.perf_counter()
+                with tracer.span("variant", variant=va.full_name) as vsp:
+                    ok = self.prepare(va, engine, classes, accelerators, spec, report)
+                    vsp.set(prepared=ok)
+                self.instruments.observe_analysis(
+                    va.namespace, va.name, time.perf_counter() - t0
+                )
+                if ok:
+                    prepared.append(va)
+            sp.set(variants_prepared=len(prepared))
         report.variants_prepared = len(prepared)
         if not prepared:
-            return report
+            return
 
         system = System(spec)
-        t0 = time.perf_counter()
-        try:
-            if self.config.compute_backend in ("tpu", "tpu-pallas", "native"):
-                from inferno_tpu.parallel import calculate_fleet
+        with tracer.span("solve", backend=self.config.compute_backend) as sp:
+            t0 = time.perf_counter()
+            try:
+                if self.config.compute_backend in ("tpu", "tpu-pallas", "native"):
+                    from inferno_tpu.parallel import calculate_fleet
 
-                calculate_fleet(system, backend=self.config.compute_backend)
-            else:
-                system.calculate_all()
-            report.analysis_ms = (time.perf_counter() - t0) * 1000.0
-            result = Optimizer(optimizer_spec).optimize(system, calculate=False)
-            report.solver_ms = result.solution_time_msec
-            solution = result.solution
-        except Exception as e:  # optimization failed: mark all, retry next cycle
-            # (reference: controller.go:168-186)
-            report.optimization_ok = False
-            report.errors.append(f"optimize: {e}")
-            for va in prepared:
-                if not self.gate():
-                    report.errors.append("leadership lost; stopping status writes")
-                    break
-                va.status.set_condition(
-                    TYPE_OPTIMIZATION_READY, "False", REASON_OPTIMIZATION_FAILED, str(e)
-                )
-                try:
-                    self.kube.update_variant_autoscaling_status(va)
-                except KubeError:
-                    pass
-            return report
+                    calculate_fleet(system, backend=self.config.compute_backend)
+                else:
+                    system.calculate_all()
+                report.analysis_ms = (time.perf_counter() - t0) * 1000.0
+                result = Optimizer(optimizer_spec).optimize(system, calculate=False)
+                report.solver_ms = result.solution_time_msec
+                solution = result.solution
+            except Exception as e:  # optimization failed: mark all, retry next cycle
+                # (reference: controller.go:168-186)
+                report.optimization_ok = False
+                report.errors.append(f"optimize: {e}")
+                sp.set(error=str(e))
+                prepared_names = {va.full_name for va in prepared}
+                for rec in report.decisions:
+                    if rec.variant in prepared_names:
+                        rec.decide(REASON_ERROR, detail=f"optimization failed: {e}")
+                for va in prepared:
+                    if not self.gate():
+                        report.errors.append("leadership lost; stopping status writes")
+                        break
+                    va.status.set_condition(
+                        TYPE_OPTIMIZATION_READY, "False", REASON_OPTIMIZATION_FAILED, str(e)
+                    )
+                    try:
+                        self.kube.update_variant_autoscaling_status(va)
+                    except KubeError:
+                        pass
+                return
+            self.instruments.observe_solver(report.solver_ms / 1000.0)
+            sp.set(
+                sizing_ms=round(report.analysis_ms, 3),
+                solver_ms=round(report.solver_ms, 3),
+            )
 
-        self._apply(prepared, solution, report)
-        return report
+        with tracer.span("actuate") as sp:
+            self._apply(prepared, solution, report, system)
+            sp.set(variants_applied=report.variants_applied)
+
+    def _finish_cycle(self, tracer: Tracer, report: CycleReport) -> None:
+        """Seal the cycle's observability outputs: trace, histogram,
+        decision log events, ring-buffer entry, readiness heartbeat."""
+        root = tracer.finish()
+        report.trace = root
+        self.instruments.observe_cycle(root.duration_ms / 1000.0)
+        for rec in report.decisions:
+            kv(self.log, logging.INFO, "decision", **rec.to_dict())
+        self.traces.append(
+            {
+                "started_at": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime(tracer.started_at)
+                ),
+                "duration_ms": round(root.duration_ms, 3),
+                "optimization_ok": report.optimization_ok,
+                "errors": list(report.errors),
+                "spans": root.to_dict(),
+                "decisions": [rec.to_dict() for rec in report.decisions],
+            }
+        )
+        # stale-controller detection (metrics._probe_routes): readiness
+        # fails when the newest heartbeat is older than 3x the interval
+        self._heartbeat(report.interval_seconds)
+
+    def _heartbeat(self, interval_seconds: int) -> None:
+        """Refresh the readiness staleness heartbeat (cycle completion or
+        non-leader standby idle)."""
+        if self.ready_flag is not None:
+            self.ready_flag["last_cycle_monotonic"] = time.monotonic()
+            self.ready_flag["max_cycle_age_s"] = 3.0 * max(interval_seconds, 1)
 
     def _apply(
         self,
         prepared: list[VariantAutoscaling],
         solution: dict[str, Any],
         report: CycleReport,
+        system: System | None = None,
     ) -> None:
-        """(reference applyOptimizedAllocations: controller.go:338-407)"""
+        """(reference applyOptimizedAllocations: controller.go:338-407)
+        Also completes each prepared variant's DecisionRecord: the solved
+        allocation (or its absence) is the decision being explained."""
         now = _utcnow()
-        for va in prepared:
+        recs = {r.variant: r for r in report.decisions}
+        for i, va in enumerate(prepared):
+            rec = recs.get(va.full_name)
             if not self.gate():
                 report.errors.append(
                     "leadership lost mid-cycle; aborting actuation and status writes"
                 )
+                # every not-yet-applied variant gets the explanation — not
+                # just the one being processed: an operator reading
+                # /debug/decisions must see "handoff", not bare errors
+                for later in prepared[i:]:
+                    lrec = recs.get(later.full_name)
+                    if lrec is not None:
+                        lrec.detail = "leadership lost mid-cycle; decision not actuated"
                 return
             try:
                 fresh = self.kube.get_variant_autoscaling(va.namespace, va.name)
             except KubeError as e:
                 report.errors.append(f"{va.full_name}: refetch: {e}")
+                if rec is not None:
+                    rec.decide(REASON_ERROR, detail=f"refetch: {e}")
                 continue
             fresh.status = va.status
             alloc = solution.get(va.full_name)
@@ -707,6 +869,8 @@ class Reconciler:
                     REASON_OPTIMIZATION_SUCCEEDED,
                     "optimization completed",
                 )
+                if rec is not None:
+                    self._explain_decision(rec, va.full_name, alloc, system)
             else:
                 # squeezed out (capacity exhausted / SLO unachievable): the
                 # decision this cycle is the minimum — leaving the stale
@@ -720,9 +884,8 @@ class Reconciler:
                 # exactly the minimum, not min(stale, floor): a fresh VA's
                 # stale desired is 0, and clamping against it would scale a
                 # never-optimized variant to zero with scale-to-zero off
-                fresh.status.desired_optimized_alloc.num_replicas = (
-                    0 if self.config.scale_to_zero else 1
-                )
+                floor = 0 if self.config.scale_to_zero else 1
+                fresh.status.desired_optimized_alloc.num_replicas = floor
                 fresh.status.desired_optimized_alloc.last_run_time = now
                 fresh.status.set_condition(
                     TYPE_OPTIMIZATION_READY,
@@ -730,6 +893,13 @@ class Reconciler:
                     REASON_OPTIMIZATION_FAILED,
                     "no feasible allocation (SLO unachievable or capacity exhausted)",
                 )
+                if rec is not None:
+                    rec.decide(
+                        REASON_CAPACITY_LIMITED,
+                        replicas=floor,
+                        detail="no feasible allocation "
+                               "(SLO unachievable or capacity exhausted)",
+                    )
             try:
                 self.actuator.emit_metrics(fresh)
                 fresh.status.actuation_applied = True
@@ -744,18 +914,62 @@ class Reconciler:
             except KubeError as e:
                 report.errors.append(f"{va.full_name}: status: {e}")
 
+    def _explain_decision(
+        self, rec: DecisionRecord, server_name: str, alloc, system: System | None
+    ) -> None:
+        """Fill a DecisionRecord from the solved allocation. Reason-code
+        semantics: `asleep` when the variant was sized from gateway demand
+        at zero replicas; `slo_bound` when load pushed the replica count
+        above the configured floor (the SLO ceiling λ_max dictated N);
+        `cost_bound` when the variant sits at its floor and the choice was
+        purely cost-minimal."""
+        server = system.servers.get(server_name) if system is not None else None
+        chosen = server.allocation if server is not None else None
+        min_replicas = server.min_num_replicas if server is not None else 1
+        if rec.asleep:
+            reason = REASON_ASLEEP
+            detail = "scaled to zero; sized from gateway demand"
+        elif alloc.num_replicas > min_replicas:
+            reason = REASON_SLO_BOUND
+            detail = "replicas sized by observed load against the SLO ceiling"
+        else:
+            reason = REASON_COST_BOUND
+            detail = "at the replica floor; cost-minimal shape retained"
+        rec.decide(
+            reason,
+            accelerator=alloc.accelerator,
+            replicas=alloc.num_replicas,
+            detail=detail,
+        )
+        rec.ttft_predicted_ms = alloc.ttft_average
+        rec.itl_predicted_ms = alloc.itl_average
+        # headroom = SLO minus prediction (positive = margin); a 0 SLO
+        # means the dimension is unconstrained and its headroom is noise
+        rec.ttft_headroom_ms = rec.slo_ttft_ms - alloc.ttft_average
+        rec.itl_headroom_ms = rec.slo_itl_ms - alloc.itl_average
+        rec.cost = alloc.cost
+        rec.cost_delta = alloc.cost - rec.prev_cost
+        if chosen is not None:
+            rec.lambda_max_rpm = chosen.max_rpm
+
     def run_forever(self, stop_check=lambda: False, gate=lambda: True) -> None:
         """Interval-driven steady state (the reference uses RequeueAfter,
         controller.go:201). `gate` is the leadership check: a non-leader
         idles without reconciling (reference: manager suspends controllers
         until elected)."""
-        import logging
-
-        from inferno_tpu.controller.logger import kv
-
         self.gate = gate
+        # initial heartbeat BEFORE the first cycle: a controller that
+        # hangs inside cycle #1 (blackholed Prom query after the startup
+        # gate passed) must still trip the staleness check — without this
+        # stamp the age test never arms and /readyz stays 200 forever
+        self._heartbeat(self.config.interval_seconds)
         while not stop_check():
             if not gate():
+                # a non-leader standby idles BY DESIGN: refresh the
+                # readiness heartbeat so the staleness check (metrics.
+                # _probe_routes) doesn't mark a healthy standby not-ready
+                # for never cycling
+                self._heartbeat(self.config.interval_seconds)
                 time.sleep(1)
                 continue
             report = self.run_cycle()

@@ -28,6 +28,7 @@ from typing import Any
 from inferno_tpu.emulator.disagg import DisaggEngine, DisaggProfile
 from inferno_tpu.emulator.engine import EmulatedEngine, EngineProfile
 from inferno_tpu.emulator.loadgen import LoadGenerator, RateSpec
+from inferno_tpu.obs import Tracer
 
 
 @dataclasses.dataclass(frozen=True)
@@ -163,79 +164,89 @@ def run_scenario(scenario: Scenario) -> dict[str, Any]:
             "emu_paced requires a single aggregated replica "
             f"(got replicas={scenario.replicas}, disagg={scenario.disagg is not None})"
         )
+    # span trace of the experiment (obs/trace.py): one child per run with
+    # drive/drain/collect phases, attached to the result as `trace` so a
+    # slow scenario is attributable (driving vs draining vs host overhead)
+    tracer = Tracer(f"scenario:{scenario.name}")
     per_run: list[RunStats] = []
     for run_idx in range(scenario.runs):
         stats = RunStats()
-        engines = [
-            DisaggEngine(scenario.disagg, time_scale=scenario.time_scale)
-            if scenario.disagg is not None
-            else EmulatedEngine(scenario.profile, time_scale=scenario.time_scale)
-            for _ in range(scenario.replicas)
-        ]
-        for e in engines:
-            e.start()
-        gen = LoadGenerator(
-            engines,
-            scenario.rate,
-            in_tokens=scenario.in_tokens,
-            out_tokens=scenario.out_tokens,
-            poisson=scenario.poisson,
-            seed=scenario.seed + run_idx,
-            schedule_clock=(
-                (lambda e=engines[0]: e.emu_ms / 1000.0)
-                if scenario.emu_paced else None
-            ),
-            wall_per_unit=(
-                scenario.time_scale if scenario.emu_paced else 1.0
-            ),
-        )
+        with tracer.span("run", run=run_idx) as run_sp:
+            engines = [
+                DisaggEngine(scenario.disagg, time_scale=scenario.time_scale)
+                if scenario.disagg is not None
+                else EmulatedEngine(scenario.profile, time_scale=scenario.time_scale)
+                for _ in range(scenario.replicas)
+            ]
+            for e in engines:
+                e.start()
+            gen = LoadGenerator(
+                engines,
+                scenario.rate,
+                in_tokens=scenario.in_tokens,
+                out_tokens=scenario.out_tokens,
+                poisson=scenario.poisson,
+                seed=scenario.seed + run_idx,
+                schedule_clock=(
+                    (lambda e=engines[0]: e.emu_ms / 1000.0)
+                    if scenario.emu_paced else None
+                ),
+                wall_per_unit=(
+                    scenario.time_scale if scenario.emu_paced else 1.0
+                ),
+            )
 
-        # telemetry sampler thread (the reference samples device memory
-        # every iteration; we sample KV + queue depths at 50Hz)
-        stop = threading.Event()
+            # telemetry sampler thread (the reference samples device memory
+            # every iteration; we sample KV + queue depths at 50Hz)
+            stop = threading.Event()
 
-        def sample() -> None:
-            while not stop.is_set():
+            def sample() -> None:
+                while not stop.is_set():
+                    for e in engines:
+                        stats.kv_used.append(e.kv_used_fraction())
+                        stats.batch_depth.append(e.num_running)
+                        stats.queue_depth.append(e.num_waiting)
+                    time.sleep(0.02)
+
+            sampler = threading.Thread(target=sample, daemon=True)
+            sampler.start()
+            with tracer.span("drive"):
+                gen.start()
+                gen.join()
+            # emulated length of the arrival window, before drain idles the
+            # clocks further: the measured operating point for the model
+            # check. Emu-paced runs read the generator's own schedule clock
+            # (engine clocks fold in thread-startup idle, a systematic
+            # realized-rate underestimate).
+            if scenario.emu_paced and gen.elapsed > 0:
+                stats.emu_window_ms = gen.elapsed * 1000.0
+            else:
+                stats.emu_window_ms = sum(e.emu_ms for e in engines)
+            stats.submitted = gen.submitted
+            # drain: wait for in-flight work to finish
+            with tracer.span("drain"):
+                deadline = time.time() + 30.0
+                while time.time() < deadline and any(
+                    e.num_running or e.num_waiting for e in engines
+                ):
+                    time.sleep(0.02)
+            stop.set()
+            sampler.join(timeout=1.0)
+            with tracer.span("collect"):
                 for e in engines:
-                    stats.kv_used.append(e.kv_used_fraction())
-                    stats.batch_depth.append(e.num_running)
-                    stats.queue_depth.append(e.num_waiting)
-                time.sleep(0.02)
-
-        sampler = threading.Thread(target=sample, daemon=True)
-        sampler.start()
-        gen.start()
-        gen.join()
-        # emulated length of the arrival window, before drain idles the
-        # clocks further: the measured operating point for the model
-        # check. Emu-paced runs read the generator's own schedule clock
-        # (engine clocks fold in thread-startup idle, a systematic
-        # realized-rate underestimate).
-        if scenario.emu_paced and gen.elapsed > 0:
-            stats.emu_window_ms = gen.elapsed * 1000.0
-        else:
-            stats.emu_window_ms = sum(e.emu_ms for e in engines)
-        stats.submitted = gen.submitted
-        # drain: wait for in-flight work to finish
-        deadline = time.time() + 30.0
-        while time.time() < deadline and any(
-            e.num_running or e.num_waiting for e in engines
-        ):
-            time.sleep(0.02)
-        stop.set()
-        sampler.join(timeout=1.0)
-        for e in engines:
-            e.stop()
-            for _, res in e.completions:
-                stats.requests += 1
-                # virtual-clock (profile msec) timings, free of host
-                # scheduling overhead
-                stats.ttft_ms.append(res.ttft_emu_ms)
-                stats.latency_ms.append(res.latency_emu_ms)
-                if res.out_tokens > 1:
-                    stats.itl_ms.append(
-                        (res.latency_emu_ms - res.ttft_emu_ms) / (res.out_tokens - 1)
-                    )
+                    e.stop()
+                    for _, res in e.completions:
+                        stats.requests += 1
+                        # virtual-clock (profile msec) timings, free of host
+                        # scheduling overhead
+                        stats.ttft_ms.append(res.ttft_emu_ms)
+                        stats.latency_ms.append(res.latency_emu_ms)
+                        if res.out_tokens > 1:
+                            stats.itl_ms.append(
+                                (res.latency_emu_ms - res.ttft_emu_ms)
+                                / (res.out_tokens - 1)
+                            )
+            run_sp.set(requests=stats.requests, submitted=stats.submitted)
         per_run.append(stats)
 
     requests = sum(s.requests for s in per_run)
@@ -270,18 +281,20 @@ def run_scenario(scenario: Scenario) -> dict[str, Any]:
     # second. Only meaningful for stationary schedules — queueing latency
     # is convex in rate, so a time-averaged rate misrepresents ramps.
     if len(scenario.rate.phases) == 1:
-        submitted = sum(s.submitted for s in per_run)
-        window_s = sum(s.emu_window_ms for s in per_run) / 1000.0
-        emu_rps = submitted / window_s if window_s > 0 else 0.0
-        result["measured_emu_rps_per_replica"] = emu_rps
-        result["model"] = _model_prediction(scenario, emu_rps)
-        model = result["model"]
-        if "itl_ms" in model and itl and model["itl_ms"] > 0:
-            result["model_error"] = {
-                "itl_rel": abs(result["itl_ms"]["mean"] - model["itl_ms"]) / model["itl_ms"]
-            }
+        with tracer.span("model-check"):
+            submitted = sum(s.submitted for s in per_run)
+            window_s = sum(s.emu_window_ms for s in per_run) / 1000.0
+            emu_rps = submitted / window_s if window_s > 0 else 0.0
+            result["measured_emu_rps_per_replica"] = emu_rps
+            result["model"] = _model_prediction(scenario, emu_rps)
+            model = result["model"]
+            if "itl_ms" in model and itl and model["itl_ms"] > 0:
+                result["model_error"] = {
+                    "itl_rel": abs(result["itl_ms"]["mean"] - model["itl_ms"]) / model["itl_ms"]
+                }
     else:
         result["model"] = {"skipped": "nonstationary rate schedule"}
+    result["trace"] = tracer.finish().to_dict()
     return result
 
 

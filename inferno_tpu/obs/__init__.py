@@ -1,0 +1,35 @@
+"""Decision-trace observability: span tracing, per-variant decision
+records, and the metric-catalog lint.
+
+Dependency-free by design (stdlib only, no controller imports) so the
+reconciler, the emulator experiment driver, and bench.py can all thread
+the same tracer without import cycles.
+"""
+
+from inferno_tpu.obs.decision import (
+    PROVENANCE_CORRECTED,
+    PROVENANCE_CR,
+    REASON_ASLEEP,
+    REASON_CAPACITY_LIMITED,
+    REASON_CODES,
+    REASON_COST_BOUND,
+    REASON_ERROR,
+    REASON_SLO_BOUND,
+    DecisionRecord,
+)
+from inferno_tpu.obs.trace import Span, TraceBuffer, Tracer
+
+__all__ = [
+    "DecisionRecord",
+    "PROVENANCE_CORRECTED",
+    "PROVENANCE_CR",
+    "REASON_ASLEEP",
+    "REASON_CAPACITY_LIMITED",
+    "REASON_CODES",
+    "REASON_COST_BOUND",
+    "REASON_ERROR",
+    "REASON_SLO_BOUND",
+    "Span",
+    "TraceBuffer",
+    "Tracer",
+]

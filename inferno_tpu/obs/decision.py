@@ -1,0 +1,114 @@
+"""Per-variant sizing rationale: the DecisionRecord.
+
+The control-plane framing (PAPERS: WVA; inference-fleet-sim) treats the
+per-variant sizing rationale — observed arrival rate, the profile
+parameters actually used, the computed sustainable-rate ceiling, SLO
+headroom, and cost — as first-class output, not log prose. One
+DecisionRecord is produced per variant per reconcile cycle; it rides the
+cycle trace (`/debug/decisions`), is emitted as a structured JSON log
+event, and answers the operator question "why did replicas jump?".
+
+Units follow the controller's internal conventions: arrival rates are
+requests/minute (the collector's `arrival_rate` unit), latencies are
+milliseconds, costs are the accelerator catalog's cents/hr.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+# Reason codes — why the cycle decided what it decided for this variant.
+REASON_SLO_BOUND = "slo_bound"  # replicas sized up by load vs the SLO ceiling
+REASON_COST_BOUND = "cost_bound"  # at the replica floor; cost-minimal choice
+REASON_CAPACITY_LIMITED = "capacity_limited"  # squeezed out / infeasible
+REASON_ASLEEP = "asleep"  # scaled to zero; sized from gateway demand
+REASON_ERROR = "error"  # preparation or optimization failed this cycle
+
+REASON_CODES = (
+    REASON_SLO_BOUND,
+    REASON_COST_BOUND,
+    REASON_CAPACITY_LIMITED,
+    REASON_ASLEEP,
+    REASON_ERROR,
+)
+
+# Profile-parameter provenance values
+PROVENANCE_CR = "cr"  # CR-carried static profile used as-is
+PROVENANCE_CORRECTED = "corrected"  # corrector-calibrated parameters
+
+
+@dataclasses.dataclass
+class DecisionRecord:
+    """What the cycle observed, assumed, and decided for one variant."""
+
+    variant: str  # namespace/name
+    namespace: str = ""
+    name: str = ""
+    model: str = ""
+    reason: str = REASON_ERROR
+    detail: str = ""  # human-readable amplification (error text, notes)
+
+    # -- observed state (the collector's view this cycle) -------------------
+    arrival_rpm: float = 0.0  # observed λ, requests/minute
+    ttft_observed_ms: float = 0.0
+    itl_observed_ms: float = 0.0
+    asleep: bool = False  # scaled to zero, sized from gateway demand
+
+    # -- sizing inputs ------------------------------------------------------
+    profile_provenance: str = PROVENANCE_CR  # "cr" | "corrected"
+    slo_ttft_ms: float = 0.0
+    slo_itl_ms: float = 0.0
+
+    # -- the decision -------------------------------------------------------
+    accelerator: str = ""
+    replicas: int = 0
+    prev_accelerator: str = ""
+    prev_replicas: int = 0
+    # per-replica sustainable arrival-rate ceiling λ_max at the chosen
+    # operating point, requests/minute (Allocation.max_rpm)
+    lambda_max_rpm: float = 0.0
+    ttft_predicted_ms: float = 0.0
+    itl_predicted_ms: float = 0.0
+    # SLO minus prediction: positive = margin, negative = expected breach
+    ttft_headroom_ms: float = 0.0
+    itl_headroom_ms: float = 0.0
+    cost: float = 0.0  # cents/hr of the chosen allocation
+    prev_cost: float = 0.0
+    cost_delta: float = 0.0  # chosen minus previous
+
+    def __post_init__(self) -> None:
+        if self.reason not in REASON_CODES:
+            raise ValueError(
+                f"reason must be one of {REASON_CODES}, got {self.reason!r}"
+            )
+
+    def decide(
+        self,
+        reason: str,
+        *,
+        accelerator: str = "",
+        replicas: int = 0,
+        detail: str = "",
+    ) -> "DecisionRecord":
+        """Stamp the outcome; returns self for chaining."""
+        if reason not in REASON_CODES:
+            raise ValueError(
+                f"reason must be one of {REASON_CODES}, got {reason!r}"
+            )
+        self.reason = reason
+        self.accelerator = accelerator
+        self.replicas = replicas
+        if detail:
+            self.detail = detail
+        return self
+
+    def to_dict(self) -> dict[str, Any]:
+        """JSON-ready flat dict; floats rounded so log lines stay legible."""
+        out: dict[str, Any] = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, float):
+                v = round(v, 4)
+            out[f.name] = v
+        return out

@@ -1,0 +1,58 @@
+"""Metric-catalog lint (`make lint-metrics`).
+
+Asserts every series the controller registers carries non-empty help
+text and the `inferno_` name prefix — the two properties
+docs/observability.md relies on to stay a complete catalogue. Runs as a
+CLI (wired into the Makefile) and from tests/test_metrics_lint.py, both
+against the same registry construction the production entry point uses.
+"""
+
+from __future__ import annotations
+
+import sys
+
+METRIC_NAME_PREFIX = "inferno_"
+
+
+def lint_registry(registry) -> list[str]:
+    """Violations in a `controller.metrics.Registry`; empty means clean."""
+    violations: list[str] = []
+    for name, help_, kind in registry.catalog():
+        if not name.startswith(METRIC_NAME_PREFIX):
+            violations.append(
+                f"{name} ({kind}): missing the {METRIC_NAME_PREFIX!r} name prefix"
+            )
+        if not help_.strip():
+            violations.append(f"{name} ({kind}): empty help text")
+    return violations
+
+
+def build_controller_registry():
+    """The full production metric catalog, exactly as main() assembles it:
+    the four actuation series (MetricsEmitter) plus the cycle-latency
+    histograms (CycleInstruments)."""
+    from inferno_tpu.controller.metrics import (
+        CycleInstruments,
+        MetricsEmitter,
+        Registry,
+    )
+
+    registry = Registry()
+    MetricsEmitter(registry)
+    CycleInstruments(registry)
+    return registry
+
+
+def main() -> int:
+    registry = build_controller_registry()
+    violations = lint_registry(registry)
+    for v in violations:
+        print(f"lint-metrics: {v}", file=sys.stderr)
+    if violations:
+        return 1
+    print(f"lint-metrics: {len(list(registry.catalog()))} series clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

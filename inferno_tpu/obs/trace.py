@@ -1,0 +1,140 @@
+"""Lightweight monotonic-clock span tracing for decision observability.
+
+The autoscaler's product is a decision, and ISSUE-3's premise is that
+every reconcile cycle must be explainable after the fact: which phase
+ran, how long it took, and what per-variant facts the sizing saw. This
+module is the substrate — a context-manager span tracer in the spirit of
+OpenTelemetry's API surface but with zero dependencies and zero
+exporters: spans are plain dataclasses, durations come from
+`time.perf_counter()` (monotonic — wall-clock steps from NTP must never
+produce negative phase durations), and a bounded ring buffer retains the
+last K cycle traces for the `/debug/decisions` route.
+
+Threading model: a `Tracer` is single-threaded by design (spans nest via
+a plain stack, exactly matching the reconciler's sequential phases); the
+`TraceBuffer` is the only cross-thread surface (reconcile thread appends,
+HTTP handler threads snapshot) and locks accordingly.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Any, Iterator
+
+
+@dataclasses.dataclass
+class Span:
+    """One timed operation. `start_ms` is the offset from the trace root's
+    start on the monotonic clock, so sibling spans order correctly even
+    across wall-clock adjustments."""
+
+    name: str
+    start_ms: float = 0.0
+    duration_ms: float = 0.0
+    attrs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    children: list["Span"] = dataclasses.field(default_factory=list)
+
+    def set(self, **attrs: Any) -> "Span":
+        """Attach attributes mid-span (e.g. counts known only at the end)."""
+        self.attrs.update(attrs)
+        return self
+
+    def walk(self) -> Iterator["Span"]:
+        """Depth-first over this span and all descendants."""
+        yield self
+        for child in self.children:
+            yield from child.walk()
+
+    def find(self, name: str) -> "Span | None":
+        """First span named `name` in depth-first order (test/summary aid)."""
+        return next((s for s in self.walk() if s.name == name), None)
+
+    def to_dict(self) -> dict[str, Any]:
+        """JSON-ready tree. Durations are rounded to microseconds — the
+        exported artifact is for operators, not for re-deriving timings."""
+        out: dict[str, Any] = {
+            "name": self.name,
+            "start_ms": round(self.start_ms, 3),
+            "duration_ms": round(self.duration_ms, 3),
+        }
+        if self.attrs:
+            out["attrs"] = self.attrs
+        if self.children:
+            out["children"] = [c.to_dict() for c in self.children]
+        return out
+
+
+class Tracer:
+    """Per-cycle trace builder with a context-manager span API:
+
+        tracer = Tracer("reconcile-cycle")
+        with tracer.span("collect", namespace="ns") as sp:
+            ...
+            sp.set(variants=3)
+        root = tracer.finish()
+
+    Spans opened while another span is active nest under it. `finish()`
+    stamps the root duration and is idempotent, so every exit path of a
+    traced operation can call it safely.
+    """
+
+    def __init__(self, name: str = "trace"):
+        self.started_at = time.time()  # wall clock, operator display only
+        self._t0 = time.perf_counter()
+        self.root = Span(name=name)
+        self._stack: list[Span] = [self.root]
+        self._finished = False
+
+    def _now_ms(self) -> float:
+        return (time.perf_counter() - self._t0) * 1000.0
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Span]:
+        sp = Span(name=name, start_ms=self._now_ms(), attrs=dict(attrs))
+        self._stack[-1].children.append(sp)
+        self._stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.duration_ms = self._now_ms() - sp.start_ms
+            self._stack.pop()
+
+    def finish(self) -> Span:
+        if not self._finished:
+            self.root.duration_ms = self._now_ms()
+            self._finished = True
+        return self.root
+
+
+class TraceBuffer:
+    """Bounded ring of recent cycle-trace documents (plain dicts, already
+    JSON-ready). Appends evict the oldest entry beyond `capacity`; every
+    document is stamped with a monotonically increasing `seq` so a reader
+    polling `/debug/decisions` can detect cycles it missed."""
+
+    def __init__(self, capacity: int = 32):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._items: collections.deque[dict] = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def append(self, doc: dict[str, Any]) -> int:
+        with self._lock:
+            self._seq += 1
+            self._items.append({"seq": self._seq, **doc})
+            return self._seq
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        """Oldest-first copy of the retained traces."""
+        with self._lock:
+            return list(self._items)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)

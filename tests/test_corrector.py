@@ -149,6 +149,7 @@ def test_surrogate_refit_beats_ratio_on_nonlinear_truth():
     assert float(refit_err.mean()) < 0.5 * float(raw_err.mean())
 
 
+@pytest.mark.slow  # ~13s of wall-paced emulation — outside the tier-1 budget
 def test_live_calibration_observe_correct_resize_no_flapping():
     """Live calibration through the real reconcile cycle (ISSUE r6
     tentpole): the CR carries a profile ~1.3x FASTER than the emulated

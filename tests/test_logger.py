@@ -56,6 +56,28 @@ def test_exception_serialized():
     assert "boom" in rec["error"]
 
 
+def test_exception_split_into_error_and_stack():
+    """exc_info renders as a structured pair: `error` is the one-line
+    "Type: message" a log query matches on, `stack` the full traceback
+    (previously both were jammed into `error`)."""
+    buf = io.StringIO()
+    log = fresh_logger("t5", buf)
+
+    def inner():
+        raise KeyError("missing-key")
+
+    try:
+        inner()
+    except KeyError:
+        log.exception("lookup failed")
+    rec = json.loads(buf.getvalue().strip())
+    assert rec["error"] == "KeyError: 'missing-key'"
+    assert "Traceback (most recent call last)" in rec["stack"]
+    assert "inner" in rec["stack"]  # frames preserved
+    # still a single JSON line on the stream
+    assert len(buf.getvalue().strip().splitlines()) == 1
+
+
 def test_formatter_handles_nonserializable():
     f = JsonFormatter()
     rec = logging.LogRecord("x", logging.INFO, "p", 1, "m", (), None)

@@ -1,0 +1,32 @@
+"""Metric-catalog lint (the `make lint-metrics` check, in-suite): every
+series the controller registers must carry non-empty help text and the
+`inferno_` name prefix."""
+
+from inferno_tpu.controller.metrics import Registry
+from inferno_tpu.obs.lint import build_controller_registry, lint_registry, main
+
+
+def test_production_catalog_is_clean():
+    registry = build_controller_registry()
+    names = {name for name, _, _ in registry.catalog()}
+    # the four actuation series plus the four cycle-latency histograms
+    assert len(names) == 8
+    assert {"inferno_desired_replicas", "inferno_cycle_duration_seconds",
+            "inferno_variant_analysis_seconds", "inferno_solver_seconds",
+            "inferno_prom_scrape_seconds"} <= names
+    assert lint_registry(registry) == []
+
+
+def test_lint_flags_missing_prefix_and_help():
+    registry = Registry()
+    registry.gauge("inferno_good", "has help")
+    registry.gauge("rogue_series", "has help")  # wrong prefix
+    registry.histogram("inferno_silent_seconds", "")  # empty help
+    violations = lint_registry(registry)
+    assert len(violations) == 2
+    assert any("rogue_series" in v and "prefix" in v for v in violations)
+    assert any("inferno_silent_seconds" in v and "help" in v for v in violations)
+
+
+def test_lint_cli_exit_code():
+    assert main() == 0

@@ -29,13 +29,17 @@ def make_cert(tmp_path, name, cn="localhost"):
 
 @pytest.fixture()
 def tls_server(tmp_path):
-    from inferno_tpu.controller.metrics import MetricsEmitter
+    from inferno_tpu.controller.metrics import CycleInstruments, MetricsEmitter
 
     cert, key = make_cert(tmp_path, "srv")
     registry = Registry()
-    MetricsEmitter(registry).emit_replica_metrics(
+    emitter = MetricsEmitter(registry)
+    emitter.emit_replica_metrics(
         variant="v", namespace="ns", accelerator="v5e-4", current=1, desired=2
     )
+    instruments = CycleInstruments(registry)
+    instruments.observe_cycle(0.012)
+    instruments.observe_analysis("ns", "v", 0.003)
     server = MetricsServer(registry, port=0, tls=TLSConfig(cert, key))
     server.start()
     yield server, cert, key, tmp_path
@@ -54,6 +58,64 @@ def test_metrics_served_over_tls(tls_server):
     server, cert, _, _ = tls_server
     body = _fetch(server.port, cert)
     assert "inferno_desired_replicas" in body
+
+
+def test_histograms_render_over_tls(tls_server):
+    """The ISSUE-3 histogram kind rides the same TLS metrics route as the
+    gauges: cumulative buckets, +Inf, _sum/_count, labels intact."""
+    server, cert, _, _ = tls_server
+    body = _fetch(server.port, cert)
+    assert "# TYPE inferno_cycle_duration_seconds histogram" in body
+    lines = body.splitlines()
+    buckets = [
+        float(ln.rsplit(" ", 1)[1])
+        for ln in lines
+        if ln.startswith("inferno_cycle_duration_seconds_bucket")
+    ]
+    assert buckets and buckets == sorted(buckets)  # cumulative
+    assert 'inferno_cycle_duration_seconds_bucket{le="+Inf"} 1' in body
+    assert "inferno_cycle_duration_seconds_count 1" in body
+    assert any(
+        ln.startswith("inferno_variant_analysis_seconds_bucket")
+        and 'namespace="ns"' in ln and 'variant_name="v"' in ln
+        for ln in lines
+    )
+
+
+def test_histogram_series_survive_gauge_pruning():
+    """Pruning a variant's gauge series (MetricsEmitter.prune_variants)
+    must not disturb histogram series registered on the same registry —
+    and vice versa the per-variant histogram pruning must not touch the
+    gauges of variants still active (the two prune paths are disjoint)."""
+    from inferno_tpu.controller.metrics import CycleInstruments, MetricsEmitter
+
+    registry = Registry()
+    emitter = MetricsEmitter(registry)
+    emitter.emit_replica_metrics(
+        variant="gone", namespace="ns", accelerator="v5e-4", current=1, desired=2
+    )
+    emitter.emit_replica_metrics(
+        variant="kept", namespace="ns", accelerator="v5e-4", current=1, desired=1
+    )
+    instruments = CycleInstruments(registry)
+    instruments.observe_analysis("ns", "gone", 0.002)
+    instruments.observe_analysis("ns", "kept", 0.002)
+    instruments.observe_cycle(0.05)
+
+    active = {("ns", "kept")}
+    emitter.prune_variants(active)
+    instruments.prune_variants(active)
+
+    lines = registry.render().splitlines()
+    for prefix in ("inferno_desired_replicas", "inferno_variant_analysis_seconds"):
+        assert not any(
+            ln.startswith(prefix) and 'variant_name="gone"' in ln for ln in lines
+        ), prefix
+        assert any(
+            ln.startswith(prefix) and 'variant_name="kept"' in ln for ln in lines
+        ), prefix
+    # the unlabeled cycle histogram is untouched by variant pruning
+    assert "inferno_cycle_duration_seconds_count 1" in "\n".join(lines)
 
 
 def test_plain_http_rejected(tls_server):
