@@ -1011,10 +1011,60 @@ def service_rate_ceiling(decode, prefill, max_batch: int) -> float:
     return float(service_rates(decode, prefill, REQ, max_batch)[-1])
 
 
+def predictive_scaling_report(prof: dict, chosen_shape: str) -> dict:
+    """Closed-loop predictive-vs-reactive autoscaling at the benched
+    profile's operating point (emulator/experiment.py autoscale loop;
+    docs/forecasting.md). Two provenance-marked comparisons:
+
+    * `canonical` — the compressed ramp+burst schedule the non-slow test
+      asserts (tests/test_forecast.py): predictive must incur strictly
+      fewer SLO-violation seconds at equal-or-lower average cost.
+    * `production_timing` — the same schedule shape stretched to the
+      production reconcile cadence (60 s interval, catalog spin-up for
+      the chosen slice shape, HPA-default 300 s reactive stabilization):
+      how the tradeoff looks at real pacing, reported honestly even
+      where anticipation buys violation-seconds at a cost premium.
+    """
+    import dataclasses as _dc
+
+    from inferno_tpu.config.tpu_catalog import spinup_seconds
+    from inferno_tpu.emulator.engine import EngineProfile
+    from inferno_tpu.emulator.experiment import (
+        forecast_scenario,
+        run_autoscale_comparison,
+    )
+
+    profile = EngineProfile(
+        alpha=prof["alpha"], beta=prof["beta"], gamma=prof["gamma"],
+        delta=prof["delta"], max_batch=prof["max_batch"],
+    )
+    canonical = run_autoscale_comparison(forecast_scenario(profile))
+    production = run_autoscale_comparison(
+        _dc.replace(
+            forecast_scenario(
+                profile,
+                spinup_s=spinup_seconds(chosen_shape),
+                time_scale=20.0,
+                control_interval_s=60.0,
+                plant_dt_s=1.0,
+                name="ramp-burst-production",
+            ),
+            reactive_stabilization_s=300.0,
+        )
+    )
+    return {
+        "chosen_shape": chosen_shape,
+        "spinup_s": spinup_seconds(chosen_shape),
+        "canonical": canonical,
+        "production_timing": production,
+    }
+
+
 def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
                        measured_p99: dict | None = None,
                        calibrated: dict | None = None,
-                       trace: dict | None = None) -> dict:
+                       trace: dict | None = None,
+                       predictive: dict | None = None) -> dict:
     """Everything the bench measures, in one document — written to
     `bench_full.json`, NOT printed (the printed line is `compact_line`)."""
     return {
@@ -1028,6 +1078,10 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
         # replaces it — `calibrated.harvested` says whether the corrected
         # mu(n) sizing validated cheaper
         **({"calibrated": calibrated} if calibrated else {}),
+        # predictive-vs-reactive closed-loop autoscaling at the benched
+        # operating point, provenance-marked per controller flavor
+        # (reactive | predictive); see predictive_scaling_report
+        **({"predictive": predictive} if predictive else {}),
         "metric": "usd_per_mtok_at_p99_ttft_slo",
         "value": round(ns["tpu"]["usd_per_mtok"], 4),
         "unit": "USD/Mtok",
@@ -1177,12 +1231,22 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — artifact must survive
             calibrated = {"harvested": False, "error": f"{type(e).__name__}: {e}"}
             sp.set(error=str(e))
+    # predictive-vs-reactive closed loop: deterministic and fast (no
+    # threads), but guarded like the calibration phase — a regression
+    # here must never abort the headline
+    with tracer.span("predictive-autoscaling") as sp:
+        try:
+            predictive = predictive_scaling_report(prof, ns["chosen_shape"])
+        except Exception as e:  # noqa: BLE001 — artifact must survive
+            predictive = {"error": f"{type(e).__name__}: {e}"}
+            sp.set(error=str(e))
     with tracer.span("fleet-cycle-timing"):
         cycles = fleet_cycle_metrics(full=not args.quick)
     Path(FULL_PAYLOAD_PATH).write_text(
         json.dumps(build_full_payload(ns, cycles, tpu_probe, measured,
                                       calibrated,
-                                      trace=tracer.finish().to_dict()),
+                                      trace=tracer.finish().to_dict(),
+                                      predictive=predictive),
                    indent=1) + "\n"
     )
     print(compact_line(ns, cycles, tpu_probe, measured, calibrated))

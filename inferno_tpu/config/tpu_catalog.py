@@ -147,6 +147,28 @@ TPU_SLICE_CATALOG: dict[str, SliceShape] = {
 }
 
 
+# Replica spin-up latency model: how long a NEW pod-slice takes from the
+# scale-up decision to serving traffic. Dominated by slice scheduling +
+# server boot + weight load; multi-host slices additionally coordinate
+# every host of the atom (LeaderWorkerSet group), so spin-up grows with
+# the host count. These are planning constants for the forecast horizon
+# (forecast/ sizes scale-up against the predicted rate one spin-up
+# ahead), not measurements — deployments with slower image pulls or
+# larger checkpoints should raise them via their accelerator ConfigMap
+# entries in a future revision.
+SPINUP_BASE_S = 60.0  # single-host pod: schedule + boot + weight load
+SPINUP_PER_EXTRA_HOST_S = 30.0  # per additional host in the slice atom
+
+
+def spinup_seconds(shape: SliceShape | str) -> float:
+    """Estimated replica spin-up latency for a slice shape (by object or
+    canonical name) — the forecast horizon: sizing must anticipate the
+    arrival rate at decision-time + spin-up, because capacity requested
+    now arrives only then."""
+    s = slice_shape(shape) if isinstance(shape, str) else shape
+    return SPINUP_BASE_S + SPINUP_PER_EXTRA_HOST_S * (s.hosts - 1)
+
+
 def slice_shape(name: str) -> SliceShape:
     """Look up a slice shape by canonical name, e.g. ``v5e-16``.
 

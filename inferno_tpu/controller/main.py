@@ -137,6 +137,14 @@ def main() -> int:
         direct_scale=env_bool("DIRECT_SCALE"),
         profile_correction=env_bool("PROFILE_CORRECTION", True),
         keep_accelerator=env_bool("KEEP_ACCELERATOR", True),
+        # predictive scaling (docs/forecasting.md): forecast-bounded
+        # scale-up sizing, and the peak-over-window scale-down gate
+        # (seconds; keep 0 when an HPA with its own stabilization
+        # enacts the gauges)
+        predictive_scaling=env_bool("PREDICTIVE_SCALING"),
+        scale_down_stabilization_s=float(
+            os.environ.get("SCALE_DOWN_STABILIZATION_SECONDS", "0") or 0
+        ),
     )
     rec = Reconciler(
         kube=kube, prom=prom, config=config, emitter=emitter, trace_buffer=traces

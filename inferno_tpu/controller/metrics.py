@@ -334,6 +334,64 @@ class CycleInstruments:
                 self.analysis.remove(labels)
 
 
+# Predictive-scaling forecast series (forecast/forecaster.py). All carry
+# the inferno_ prefix asserted by `make lint-metrics` (obs/lint.py).
+METRIC_FORECAST_RATE = "inferno_forecast_arrival_rpm"
+METRIC_FORECAST_BAND = "inferno_forecast_band_rpm"
+METRIC_FORECAST_ERROR = "inferno_forecast_abs_error_rpm"
+
+
+class ForecastInstruments:
+    """Per-variant forecast gauges: the point estimate the sizing will
+    consult one spin-up horizon ahead, the confidence band half-width,
+    and the REALIZED absolute error of the previous one-step forecast —
+    the operator's calibration check (a forecast error persistently
+    above the band means the band_z knob is too tight). Labeled
+    (namespace, variant_name) and pruned with the actuation gauges, so a
+    deleted variant leaves no frozen forecast series behind."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self.rate = self.registry.gauge(
+            METRIC_FORECAST_RATE,
+            "Forecast arrival rate (req/min) at the spin-up horizon",
+        )
+        self.band = self.registry.gauge(
+            METRIC_FORECAST_BAND,
+            "Forecast confidence-band half-width (req/min)",
+        )
+        self.error = self.registry.gauge(
+            METRIC_FORECAST_ERROR,
+            "Realized absolute error (req/min) of the last one-step forecast",
+        )
+
+    def _labels(self, namespace: str, variant: str) -> dict[str, str]:
+        return {LABEL_OUT_NAMESPACE: namespace, LABEL_VARIANT: variant}
+
+    def set_forecast(
+        self,
+        namespace: str,
+        variant: str,
+        rate_rpm: float,
+        band_rpm: float,
+        abs_error_rpm: float,
+    ) -> None:
+        labels = self._labels(namespace, variant)
+        self.rate.set(labels, rate_rpm)
+        self.band.set(labels, band_rpm)
+        self.error.set(labels, abs_error_rpm)
+
+    def prune_variants(self, active: set[tuple[str, str]]) -> None:
+        """Drop forecast series of variants no longer managed (same
+        contract as MetricsEmitter.prune_variants)."""
+        for series in (self.rate, self.band, self.error):
+            for _, (labels, _v) in list(series.values.items()):
+                key = (labels.get(LABEL_OUT_NAMESPACE, ""),
+                       labels.get(LABEL_VARIANT, ""))
+                if key not in active:
+                    series.remove(labels)
+
+
 class TLSConfig:
     """Serve-side TLS with cert reload (the reference uses certwatchers on
     its metrics endpoint, cmd/main.go:122-199). Certs are re-read when the

@@ -62,11 +62,14 @@ from inferno_tpu.controller.promclient import PromClient, PromError
 from inferno_tpu.core import System
 from inferno_tpu.obs import (
     PROVENANCE_CORRECTED,
+    RATE_PROVENANCE_FORECAST,
     REASON_ASLEEP,
     REASON_CAPACITY_LIMITED,
     REASON_COST_BOUND,
     REASON_ERROR,
+    REASON_FORECAST_BOUND,
     REASON_SLO_BOUND,
+    REASON_STABILIZATION_HOLD,
     DecisionRecord,
     Span,
     TraceBuffer,
@@ -153,6 +156,11 @@ class ReconcilerConfig:
                 f"compute_backend must be auto|tpu|tpu-pallas|native|scalar, "
                 f"got {self.compute_backend!r}"
             )
+        if self.scale_down_stabilization_s < 0:
+            raise ValueError(
+                f"scale_down_stabilization_s must be >= 0, "
+                f"got {self.scale_down_stabilization_s}"
+            )
         engine_for(self.engine)  # raise at config time on unknown engines
         if not self.keep_accelerator and self.direct_scale:
             # direct_scale only patches replica counts on the EXISTING
@@ -178,6 +186,23 @@ class ReconcilerConfig:
     # expect churn tolerance from the serving stack (a shape change
     # re-provisions every pod-slice of the variant)
     keep_accelerator: bool = True
+    # predictive scaling (inferno_tpu/forecast/, docs/forecasting.md):
+    # size scale-UP against max(observed λ, forecast upper band at the
+    # replica spin-up horizon) so a traffic ramp is provisioned for
+    # BEFORE it breaches, instead of one spin-up interval after. OFF by
+    # default: anticipatory sizing deliberately holds capacity above the
+    # instantaneous observed demand while a ramp decays, which changes
+    # the scale-release timing every reactive deployment was tuned
+    # around — operators opt in (env PREDICTIVE_SCALING)
+    predictive_scaling: bool = False
+    # scale-down stabilization window in seconds (0 = disabled): desired
+    # replicas act on the PEAK recommendation of the trailing window,
+    # mirroring HPA behavior.scaleDown.stabilizationWindowSeconds.
+    # Meaningful for the direct_scale/KEDA actuation paths — when an HPA
+    # enacts the gauges, its own stabilization already applies and this
+    # window should usually stay 0 (double-gating delays legitimate
+    # scale-down twice)
+    scale_down_stabilization_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -215,7 +240,11 @@ class Reconciler:
         emitter=None,
         trace_buffer: TraceBuffer | None = None,
     ):
-        from inferno_tpu.controller.metrics import CycleInstruments, MetricsEmitter
+        from inferno_tpu.controller.metrics import (
+            CycleInstruments,
+            ForecastInstruments,
+            MetricsEmitter,
+        )
 
         from inferno_tpu.controller.logger import get_logger
 
@@ -252,6 +281,35 @@ class Reconciler:
             self.corrector = ProfileCorrector()
         else:
             self.corrector = None
+        # predictive scaling (forecast/): the per-variant arrival-rate
+        # forecaster consulted before sizing, and the peak-over-window
+        # scale-down gate. The forecast gauges register unconditionally
+        # so the metric catalog (and `make lint-metrics`) is identical
+        # whether or not the feature is on.
+        self.forecast_instruments = ForecastInstruments(self.emitter.registry)
+        if self.config.predictive_scaling:
+            from inferno_tpu.forecast import ArrivalForecaster, ForecastConfig
+
+            # EWMA gains are calibrated per reconcile interval: the
+            # forecaster time-weights them by actual observation spacing
+            self.forecaster = ArrivalForecaster(
+                ForecastConfig(
+                    reference_interval_s=max(self.config.interval_seconds, 1)
+                )
+            )
+        else:
+            self.forecaster = None
+        if self.config.scale_down_stabilization_s > 0:
+            from inferno_tpu.forecast import ScaleDownStabilizer
+
+            self.stabilizer = ScaleDownStabilizer(
+                self.config.scale_down_stabilization_s
+            )
+        else:
+            self.stabilizer = None
+        # forecast/stabilizer timestamp source — injectable so tests can
+        # step cycles at a controlled cadence instead of real time
+        self.clock: Callable[[], float] = time.monotonic
         # set by a Watcher (or anyone) to trigger the next cycle early
         self._wake = threading.Event()
         # Leadership gate, re-checked at every write: a leader deposed
@@ -574,6 +632,47 @@ class Reconciler:
         rec.prev_replicas = current.num_replicas
         rec.prev_cost = current.variant_cost
 
+        # predictive scaling: feed this cycle's observed λ into the
+        # forecaster and size scale-UP against max(observed, forecast
+        # upper band) at the spin-up horizon — capacity requested now
+        # serves only one spin-up latency from now, so the rate to
+        # provision for is the one the forecast sees there. Asleep
+        # variants participate too: gateway demand is a real arrival
+        # series and the wake-up decision benefits from its trend.
+        lam_sizing = current.load.arrival_rate
+        rec.sizing_rpm = lam_sizing
+        if self.forecaster is not None:
+            from inferno_tpu.config.tpu_catalog import spinup_seconds
+
+            self.forecaster.observe(
+                va.full_name, self.clock(), current.load.arrival_rate
+            )
+            acc_now = current.accelerator or matching_profiles[0].acc
+            # horizon = spin-up latency + one reconcile interval: a ramp
+            # breach just after this decision is only re-decided one
+            # interval from now, and THAT capacity serves one spin-up
+            # later still — so this cycle must cover demand through
+            # interval + spin-up (same horizon the closed-loop scenario
+            # validates, emulator/experiment.py)
+            horizon = spinup_seconds(acc_now) + report.interval_seconds
+            fc = self.forecaster.forecast(va.full_name, horizon)
+            rec.forecast_rpm = fc.rate
+            rec.forecast_upper_rpm = fc.upper
+            rec.forecast_band_rpm = fc.band
+            rec.forecast_horizon_s = horizon
+            rec.forecast_burst = fc.burst
+            self.forecast_instruments.set_forecast(
+                va.namespace,
+                va.name,
+                fc.rate,
+                fc.band,
+                self.forecaster.realized_abs_error(va.full_name),
+            )
+            if fc.valid and fc.upper > lam_sizing:
+                lam_sizing = fc.upper
+                rec.sizing_rpm = lam_sizing
+                rec.rate_provenance = RATE_PROVENANCE_FORECAST
+
         # profile correction: feed this cycle's observation, compute the
         # current slice shape's corrected parms once, and carry the
         # multiplicative residual onto the other candidate shapes (their
@@ -658,8 +757,12 @@ class Reconciler:
                     cost=current.variant_cost,
                     itl_average=current.itl_average,
                     ttft_average=current.ttft_average,
+                    # the sizing rate: observed λ, or the forecast upper
+                    # band when predictive scaling found it higher (the
+                    # OBSERVED rate still lands in VA status/telemetry
+                    # via current_alloc above)
                     load=ServerLoadSpec(
-                        arrival_rate=current.load.arrival_rate,
+                        arrival_rate=lam_sizing,
                         avg_in_tokens=int(current.load.avg_input_tokens),
                         avg_out_tokens=int(current.load.avg_output_tokens),
                     ),
@@ -720,8 +823,16 @@ class Reconciler:
             active = {(va.namespace, va.name) for va in vas}
             self.emitter.prune_variants(active)
             self.instruments.prune_variants(active)
+            self.forecast_instruments.prune_variants(active)
             if self.corrector is not None:
                 self.corrector.prune({va.full_name for va in vas})
+            # forecaster/stabilizer state is keyed by variant full name:
+            # a deleted VA must not leave a rate history or a
+            # stabilization peak behind (unbounded per-variant state)
+            if self.forecaster is not None:
+                self.forecaster.prune({va.full_name for va in vas})
+            if self.stabilizer is not None:
+                self.stabilizer.prune({va.full_name for va in vas})
         if not vas:
             return
 
@@ -860,8 +971,30 @@ class Reconciler:
             fresh.status = va.status
             alloc = solution.get(va.full_name)
             if alloc is not None:
+                # scale-down stabilization (forecast/stabilizer.py): act
+                # on the PEAK recommendation within the trailing window —
+                # upscales pass through, downscales wait until every
+                # higher recommendation has aged out (HPA scaleDown
+                # stabilization semantics). Gated here, at the single
+                # point the solver's answer becomes the actuated desired,
+                # so the direct-scale path, the emitted gauges, and the
+                # CR status all see the same stabilized count.
+                desired = alloc.num_replicas
+                held = False
+                if self.stabilizer is not None:
+                    # keyed by variant AND slice shape: replica counts
+                    # are not comparable across a shape migration
+                    # (keep_accelerator=false) — 3x v5e-16 after 8x
+                    # v5e-8 is a shape change, not a scale-down to gate.
+                    # A migration therefore starts a fresh window; stale
+                    # shape keys are pruned with the variant.
+                    desired, held = self.stabilizer.recommend(
+                        f"{va.full_name}@{alloc.accelerator}",
+                        alloc.num_replicas,
+                        self.clock(),
+                    )
                 fresh.status.desired_optimized_alloc.accelerator = alloc.accelerator
-                fresh.status.desired_optimized_alloc.num_replicas = alloc.num_replicas
+                fresh.status.desired_optimized_alloc.num_replicas = desired
                 fresh.status.desired_optimized_alloc.last_run_time = now
                 fresh.status.set_condition(
                     TYPE_OPTIMIZATION_READY,
@@ -871,6 +1004,18 @@ class Reconciler:
                 )
                 if rec is not None:
                     self._explain_decision(rec, va.full_name, alloc, system)
+                    if held:
+                        rec.decide(
+                            REASON_STABILIZATION_HOLD,
+                            accelerator=alloc.accelerator,
+                            replicas=desired,
+                            detail=(
+                                f"scale-down gated: solver recommended "
+                                f"{alloc.num_replicas} but the peak within the "
+                                f"{self.config.scale_down_stabilization_s:.0f}s "
+                                f"stabilization window is {desired}"
+                            ),
+                        )
             else:
                 # squeezed out (capacity exhausted / SLO unachievable): the
                 # decision this cycle is the minimum — leaving the stale
@@ -923,12 +1068,30 @@ class Reconciler:
         above the configured floor (the SLO ceiling λ_max dictated N);
         `cost_bound` when the variant sits at its floor and the choice was
         purely cost-minimal."""
+        import math
+
         server = system.servers.get(server_name) if system is not None else None
         chosen = server.allocation if server is not None else None
         min_replicas = server.min_num_replicas if server is not None else 1
+        # forecast_bound: the forecast upper band (not the observed λ)
+        # was the binding sizing input — observed load alone would have
+        # needed strictly fewer replicas at the chosen λ_max ceiling
+        forecast_bound = (
+            rec.rate_provenance == RATE_PROVENANCE_FORECAST
+            and chosen is not None
+            and chosen.max_rpm > 0
+            and alloc.num_replicas > math.ceil(rec.arrival_rpm / chosen.max_rpm)
+        )
         if rec.asleep:
             reason = REASON_ASLEEP
             detail = "scaled to zero; sized from gateway demand"
+        elif forecast_bound and alloc.num_replicas > min_replicas:
+            reason = REASON_FORECAST_BOUND
+            detail = (
+                "replicas sized by the forecast upper band at the spin-up "
+                f"horizon ({rec.forecast_upper_rpm:.1f} rpm over observed "
+                f"{rec.arrival_rpm:.1f} rpm)"
+            )
         elif alloc.num_replicas > min_replicas:
             reason = REASON_SLO_BOUND
             detail = "replicas sized by observed load against the SLO ceiling"

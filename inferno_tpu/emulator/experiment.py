@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import math
 import statistics
 import sys
 import threading
@@ -335,6 +336,274 @@ def benched_point_scenario(
     )
 
 
+# -- closed-loop predictive-vs-reactive autoscaling ---------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleScenario:
+    """A closed-loop autoscaling experiment: an offered-rate schedule
+    (`RateSpec`, with ramps via `RateSpec.ramp` and burst phases), a
+    per-replica sustainable ceiling λ_max (from the queueing analyzer —
+    `sustainable_rate_rps`), and the replica spin-up latency the
+    controller must anticipate. `run_autoscale_loop` drives a controller
+    against it and scores SLO-violation seconds and cost.
+
+    The plant is the same queueing model the discrete-event emulator
+    validates elsewhere in this module (`model_error` stays small on
+    stationary schedules), stepped DETERMINISTICALLY: per plant step,
+    capacity is `serving_replicas x lambda_max_rps`; offered load beyond
+    capacity accumulates as backlog that drains only through excess
+    capacity; any step with a capacity shortfall OR an undrained backlog
+    is SLO-violating (an M/M-style queue with λ >= μ has unbounded wait,
+    and a backlog means admitted requests are still waiting out the
+    breach). No threads, no sleeps, no RNG — two runs produce identical
+    results, which is what lets a non-slow test assert a STRICT
+    predictive-vs-reactive ordering.
+
+    Times are in schedule (emulated) seconds; `spinup_s` must be
+    expressed in the same compressed unit (the production horizon is
+    `config.tpu_catalog.spinup_seconds`, in wall seconds).
+    """
+
+    name: str
+    rate: RateSpec
+    lambda_max_rps: float  # per-replica sustainable ceiling
+    spinup_s: float  # scale-up decision -> serving, schedule seconds
+    control_interval_s: float = 2.0  # reconcile cadence
+    plant_dt_s: float = 0.25  # plant integration step
+    initial_replicas: int = 1
+    max_replicas: int = 64
+    cost_per_replica_hr: float = 1.0  # any currency; comparisons are relative
+    # the reactive baseline's scale-down stabilization: HPA semantics
+    # (testing/hpa.py) with the sample policy's 120s window — a blind
+    # controller needs a long window because a dip's only credential is
+    # its duration
+    reactive_stabilization_s: float = 120.0
+    # the predictive controller runs a much shorter window: the risk
+    # stabilization bounds is "scale in, then need the capacity back
+    # before a replacement can spin up", so a couple of spin-up
+    # latencies suffice once a forecast covers the horizon. None =
+    # 2 x (spinup + control interval).
+    predictive_stabilization_s: float | None = None
+
+
+def sustainable_rate_rps(
+    profile: EngineProfile, in_tokens: int = 128, out_tokens: int = 128
+) -> float:
+    """Per-replica sustainable arrival-rate ceiling λ_max (req/s) for an
+    engine profile at a request shape — the analyzer's stable-rate
+    ceiling, the same quantity DecisionRecord.lambda_max_rpm reports in
+    req/min."""
+    from inferno_tpu.analyzer import build_analyzer
+    from inferno_tpu.analyzer.queue import RequestSize
+    from inferno_tpu.config import (
+        MAX_QUEUE_TO_BATCH_RATIO,
+        DecodeParms,
+        PrefillParms,
+    )
+
+    analyzer = build_analyzer(
+        max_batch=profile.max_batch,
+        max_queue=profile.max_batch * MAX_QUEUE_TO_BATCH_RATIO,
+        decode=DecodeParms(alpha=profile.alpha, beta=profile.beta),
+        prefill=PrefillParms(gamma=profile.gamma, delta=profile.delta),
+        request=RequestSize(avg_in_tokens=in_tokens, avg_out_tokens=out_tokens),
+    )
+    return float(analyzer.max_rate)
+
+
+def forecast_scenario(
+    profile: EngineProfile = EngineProfile(),
+    spinup_s: float = 4.0,
+    name: str = "ramp-burst",
+    time_scale: float = 1.0,
+    control_interval_s: float = 2.0,
+    plant_dt_s: float = 0.25,
+) -> AutoscaleScenario:
+    """The canonical ramp + burst + release schedule, with rates in
+    multiples of the profile's λ_max so replica counts stay readable:
+    ramp 1.3λ→5λ (RateSpec.ramp), hold, a 9λ burst, hold, ramp down,
+    and a long cheap tail where the reactive baseline's stabilization
+    window is still holding the burst peak. `time_scale` stretches every
+    phase duration — 1.0 is the compressed test schedule (~92 s with a
+    4 s spin-up); bench runs the same shape at production timing
+    (catalog spin-up, 60 s reconcile interval, time_scale ~20)."""
+    lam = sustainable_rate_rps(profile)
+    ts = time_scale
+    up = RateSpec.ramp(1.3 * lam, 5.0 * lam, 30.0 * ts, steps=6)
+    down = RateSpec.ramp(5.0 * lam, 1.5 * lam, 12.0 * ts, steps=4)
+    schedule = RateSpec(
+        up.phases
+        + ((12.0 * ts, 5.0 * lam), (6.0 * ts, 9.0 * lam), (12.0 * ts, 5.0 * lam))
+        + down.phases
+        + ((20.0 * ts, 1.5 * lam),)
+    )
+    return AutoscaleScenario(
+        name=name,
+        rate=schedule,
+        lambda_max_rps=lam,
+        spinup_s=spinup_s,
+        control_interval_s=control_interval_s,
+        plant_dt_s=plant_dt_s,
+    )
+
+
+def run_autoscale_loop(
+    scenario: AutoscaleScenario, controller: str = "reactive"
+) -> dict[str, Any]:
+    """Drive one controller flavor through the scenario.
+
+    `controller`: "reactive" sizes on the interval's observed mean rate;
+    "predictive" feeds the same observations through
+    `forecast.ArrivalForecaster` and sizes on max(observed, forecast
+    upper band at spinup + one control interval), with the shorter
+    forecast-backed stabilization window. Cost counts PROVISIONED
+    replicas (spinning-up replicas bill from the scale-up decision —
+    slices are paid for while the server loads weights).
+    """
+    from inferno_tpu.forecast import (
+        ArrivalForecaster,
+        ForecastConfig,
+        ScaleDownStabilizer,
+    )
+
+    if controller not in ("reactive", "predictive"):
+        raise ValueError(f"controller must be reactive|predictive, got {controller!r}")
+    predictive = controller == "predictive"
+    # gains calibrated to the loop's actual observation cadence
+    forecaster = (
+        ArrivalForecaster(
+            ForecastConfig(reference_interval_s=scenario.control_interval_s)
+        )
+        if predictive else None
+    )
+    window = (
+        scenario.predictive_stabilization_s
+        if scenario.predictive_stabilization_s is not None
+        else 2.0 * (scenario.spinup_s + scenario.control_interval_s)
+    ) if predictive else scenario.reactive_stabilization_s
+    stabilizer = ScaleDownStabilizer(window)
+    horizon = scenario.spinup_s + scenario.control_interval_s
+    lam_max = scenario.lambda_max_rps
+
+    serving = scenario.initial_replicas
+    pending: list[list[float]] = []  # [ready_at, count]
+    backlog = 0.0  # requests admitted beyond capacity, awaiting drain
+    violation_s = 0.0
+    replica_seconds = 0.0
+    peak_provisioned = serving
+    scale_ups = scale_downs = 0
+    dt = scenario.plant_dt_s
+    t = 0.0
+    next_control = scenario.control_interval_s
+    interval_integral = 0.0
+    interval_elapsed = 0.0
+    end = scenario.rate.total_duration
+
+    while t < end - 1e-9:
+        # promote replicas whose spin-up completed
+        ready = [p for p in pending if p[0] <= t + 1e-9]
+        if ready:
+            serving += int(sum(c for _, c in ready))
+            pending = [p for p in pending if p[0] > t + 1e-9]
+
+        lam = scenario.rate.rate_at(t)
+        capacity = serving * lam_max
+        if lam > capacity:
+            backlog += (lam - capacity) * dt
+        else:
+            backlog = max(0.0, backlog - (capacity - lam) * dt)
+        if lam > capacity or backlog > 1e-9:
+            violation_s += dt
+        provisioned = serving + int(sum(c for _, c in pending))
+        peak_provisioned = max(peak_provisioned, provisioned)
+        replica_seconds += provisioned * dt
+        interval_integral += lam * dt
+        interval_elapsed += dt
+        t += dt
+
+        if t + 1e-9 >= next_control:
+            lam_obs = interval_integral / max(interval_elapsed, 1e-9)
+            interval_integral = interval_elapsed = 0.0
+            lam_sizing = lam_obs
+            if forecaster is not None:
+                forecaster.observe(scenario.name, t, lam_obs)
+                fc = forecaster.forecast(scenario.name, horizon)
+                if fc.valid:
+                    lam_sizing = max(lam_obs, fc.upper)
+            raw = min(
+                scenario.max_replicas, max(1, math.ceil(lam_sizing / lam_max))
+            )
+            desired, _held = stabilizer.recommend(scenario.name, raw, t)
+            if desired > provisioned:
+                pending.append([t + scenario.spinup_s, desired - provisioned])
+                scale_ups += 1
+            elif desired < provisioned:
+                drop = provisioned - desired
+                scale_downs += 1
+                # cancel not-yet-ready capacity first, newest orders first
+                for p in sorted(pending, key=lambda p: -p[0]):
+                    take = min(drop, int(p[1]))
+                    p[1] -= take
+                    drop -= take
+                    if drop == 0:
+                        break
+                pending = [p for p in pending if p[1] > 0]
+                serving -= drop  # scale-in is immediate
+            next_control += scenario.control_interval_s
+
+    duration_h = end / 3600.0
+    avg_replicas = replica_seconds / end
+    return {
+        "provenance": controller,
+        "stabilization_window_s": window,
+        "slo_violation_s": round(violation_s, 3),
+        "violation_fraction": round(violation_s / end, 4),
+        "replica_seconds": round(replica_seconds, 3),
+        "avg_replicas": round(avg_replicas, 3),
+        "peak_replicas": peak_provisioned,
+        "cost": round(
+            avg_replicas * scenario.cost_per_replica_hr * duration_h, 6
+        ),
+        "final_backlog": round(backlog, 3),
+        "scale_ups": scale_ups,
+        "scale_downs": scale_downs,
+    }
+
+
+def run_autoscale_comparison(
+    scenario: AutoscaleScenario | None = None,
+) -> dict[str, Any]:
+    """Reactive baseline vs predictive controller on the same scenario,
+    provenance-marked — the bench's `predictive` block and the
+    acceptance check's subject: the predictive controller must incur
+    strictly fewer SLO-violation seconds at equal-or-lower average
+    cost."""
+    scenario = scenario or forecast_scenario()
+    reactive = run_autoscale_loop(scenario, "reactive")
+    predictive = run_autoscale_loop(scenario, "predictive")
+    return {
+        "scenario": {
+            "name": scenario.name,
+            "duration_s": scenario.rate.total_duration,
+            "phases": [list(p) for p in scenario.rate.phases],
+            "lambda_max_rps": round(scenario.lambda_max_rps, 4),
+            "spinup_s": scenario.spinup_s,
+            "control_interval_s": scenario.control_interval_s,
+        },
+        "reactive": reactive,
+        "predictive": predictive,
+        "predictive_vs_reactive": {
+            "slo_violation_s_saved": round(
+                reactive["slo_violation_s"] - predictive["slo_violation_s"], 3
+            ),
+            "cost_delta": round(
+                predictive["cost"] - reactive["cost"], 6
+            ),
+        },
+    }
+
+
 DEFAULT_SCENARIOS = (
     Scenario(name="steady-light", rate=RateSpec(((4.0, 5.0),))),
     Scenario(name="steady-heavy", rate=RateSpec(((4.0, 20.0),))),
@@ -363,7 +632,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", default="", help="write results to this path")
     ap.add_argument("--runs", type=int, default=1)
     ap.add_argument("--scenario", default="", help="run only the named scenario")
+    ap.add_argument(
+        "--autoscale", action="store_true",
+        help="run the closed-loop predictive-vs-reactive autoscale "
+             "comparison instead of the engine scenarios",
+    )
     args = ap.parse_args(argv)
+
+    if args.autoscale:
+        res = run_autoscale_comparison()
+        print(json.dumps(res, indent=1))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(res, f, indent=2)
+        return 0
 
     results = []
     for sc in DEFAULT_SCENARIOS:

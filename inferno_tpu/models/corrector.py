@@ -19,7 +19,10 @@ concurrency) leaves the calibration band:
    changes;
 3. prefill gamma/delta get a bounded multiplicative residual correction
    (TTFT observations fold queueing wait in, so a shape-refit would chase
-   noise there).
+   noise there). The prefill residual band is evaluated INDEPENDENTLY of
+   the decode band with its own hysteresis (ROADMAP r7): prefill-only
+   drift activates correction on its own, and a decode release never
+   drops a still-out-of-band prefill correction.
 
 With fewer observations than the surrogate needs, correction falls back
 to the same bounded multiplicative scaling for decode, so calibration
@@ -84,7 +87,16 @@ class Observation:
 
 @dataclasses.dataclass
 class CorrectionState:
+    # any correction in force (decode OR prefill) — the reconciler's
+    # "use corrected parms / mark provenance corrected" switch
     active: bool = False
+    # Decoupled per-phase activation (ROADMAP r7): decode (alpha/beta)
+    # and prefill (gamma/delta) drift independently — a prefill-only
+    # profile drift must activate correction without waiting on a decode
+    # residual, and a decode release must not drop a still-out-of-band
+    # prefill correction. Each phase carries its own hysteresis state.
+    decode_active: bool = False
+    prefill_active: bool = False
     decode_ratio: float = 1.0
     prefill_ratio: float = 1.0
     surrogate_used: bool = False
@@ -148,68 +160,76 @@ class ProfileCorrector:
             self._state[key] = state
             return decode, prefill, state
 
+        prev = self._state.get(key, CorrectionState())
         conc = np.array([o.concurrency for o in window])
+
+        # -- decode (alpha/beta) residual, with its OWN hysteresis ----------
+        # Activation needs the residual outside the full band; an
+        # ALREADY-ACTIVE decode correction releases only when the
+        # residual returns inside the narrower sqrt(band) — a residual
+        # hovering at the activation edge must not toggle the sizing
+        # between corrected and uncorrected parms across cycles. The
+        # decode band consults only the DECODE history (ROADMAP r7): the
+        # two phases drift independently, so neither residual may gate
+        # the other's activation or release.
         obs_itl = np.array([o.itl_ms for o in window])
         pred_itl = decode.alpha + decode.beta * conc
         log_ratio = np.log(obs_itl / np.maximum(pred_itl, 1e-9))
         median_ratio = float(np.exp(np.median(log_ratio)))
-
-        # Hysteresis (no-flapping): activation needs the residual outside
-        # the full band, but an ALREADY-ACTIVE correction releases only
-        # when the residual returns inside the narrower sqrt(band) — a
-        # residual hovering at the activation edge must not toggle the
-        # sizing between corrected and uncorrected parms across cycles.
-        prev = self._state.get(key, CorrectionState())
-        was_active = prev.active
-        band = math.sqrt(self.residual_band) if was_active else self.residual_band
-        if abs(math.log(max(median_ratio, 1e-9))) <= math.log(band):
-            self._state[key] = state
-            return decode, prefill, state
-
-        state.active = True
-        state.decode_ratio = _clamp(median_ratio)
-
-        new_decode: DecodeParms | None = None
-        if self.use_surrogate and len(window) >= SURROGATE_MIN_OBSERVATIONS:
-            seen = self._seen.get(key, len(window))
-            cached = self._refit_cache.get(key)
-            if cached is not None and seen - cached[0] < self.refit_every:
-                new_decode = cached[1]
+        d_band = (
+            math.sqrt(self.residual_band) if prev.decode_active
+            else self.residual_band
+        )
+        new_decode = decode
+        if abs(math.log(max(median_ratio, 1e-9))) > math.log(d_band):
+            state.decode_active = True
+            state.decode_ratio = _clamp(median_ratio)
+            refit: DecodeParms | None = None
+            if self.use_surrogate and len(window) >= SURROGATE_MIN_OBSERVATIONS:
+                seen = self._seen.get(key, len(window))
+                cached = self._refit_cache.get(key)
+                if cached is not None and seen - cached[0] < self.refit_every:
+                    refit = cached[1]
+                else:
+                    refit = self._surrogate_refit(window, decode)
+                    self._refit_cache[key] = (seen, refit)
+                state.surrogate_used = refit is not None
+            if refit is not None:
+                new_decode = refit
             else:
-                new_decode = self._surrogate_refit(window, decode)
-                self._refit_cache[key] = (seen, new_decode)
-            state.surrogate_used = new_decode is not None
-        if new_decode is None:
-            # graceful fallback: bounded multiplicative rescale
-            new_decode = DecodeParms(
-                alpha=decode.alpha * state.decode_ratio,
-                beta=decode.beta * state.decode_ratio,
-            )
+                # graceful fallback: bounded multiplicative rescale
+                new_decode = DecodeParms(
+                    alpha=decode.alpha * state.decode_ratio,
+                    beta=decode.beta * state.decode_ratio,
+                )
 
-        # prefill: bounded ratio on the prefill-only component. Observed
-        # TTFT includes queue wait, so only correct when observation is
-        # clearly above prediction (wait inflates, never deflates).
+        # -- prefill (gamma/delta) residual, independent hysteresis --------
+        # Bounded ratio on the prefill-only component. Observed TTFT
+        # includes queue wait, so only correct when observation is
+        # clearly ABOVE prediction (wait inflates, never deflates). A
+        # prefill-only drift activates here even with decode in-band,
+        # and a decode release leaves an out-of-band prefill correction
+        # standing.
         obs_ttft = np.array([o.ttft_ms for o in window])
         in_toks = np.array([o.in_tokens for o in window])
         pred_prefill = prefill.gamma + prefill.delta * in_toks * conc
         p_ratio = float(np.exp(np.median(np.log(
             np.maximum(obs_ttft, 1e-9) / np.maximum(pred_prefill, 1e-9)
         ))))
-        new_prefill = prefill
-        # same hysteresis as decode: an active prefill correction holds
-        # until the residual falls inside the sqrt(band) release band
         p_band = (
-            math.sqrt(self.residual_band)
-            if was_active and prev.prefill_ratio != 1.0
+            math.sqrt(self.residual_band) if prev.prefill_active
             else self.residual_band
         )
+        new_prefill = prefill
         if p_ratio > p_band:
+            state.prefill_active = True
             state.prefill_ratio = _clamp(p_ratio)
             new_prefill = PrefillParms(
                 gamma=prefill.gamma * state.prefill_ratio,
                 delta=prefill.delta * state.prefill_ratio,
             )
 
+        state.active = state.decode_active or state.prefill_active
         self._state[key] = state
         return new_decode, new_prefill, state
 

@@ -9,12 +9,16 @@ the same tracer without import cycles.
 from inferno_tpu.obs.decision import (
     PROVENANCE_CORRECTED,
     PROVENANCE_CR,
+    RATE_PROVENANCE_FORECAST,
+    RATE_PROVENANCE_OBSERVED,
     REASON_ASLEEP,
     REASON_CAPACITY_LIMITED,
     REASON_CODES,
     REASON_COST_BOUND,
     REASON_ERROR,
+    REASON_FORECAST_BOUND,
     REASON_SLO_BOUND,
+    REASON_STABILIZATION_HOLD,
     DecisionRecord,
 )
 from inferno_tpu.obs.trace import Span, TraceBuffer, Tracer
@@ -23,12 +27,16 @@ __all__ = [
     "DecisionRecord",
     "PROVENANCE_CORRECTED",
     "PROVENANCE_CR",
+    "RATE_PROVENANCE_FORECAST",
+    "RATE_PROVENANCE_OBSERVED",
     "REASON_ASLEEP",
     "REASON_CAPACITY_LIMITED",
     "REASON_CODES",
     "REASON_COST_BOUND",
     "REASON_ERROR",
+    "REASON_FORECAST_BOUND",
     "REASON_SLO_BOUND",
+    "REASON_STABILIZATION_HOLD",
     "Span",
     "TraceBuffer",
     "Tracer",

@@ -24,6 +24,9 @@ REASON_COST_BOUND = "cost_bound"  # at the replica floor; cost-minimal choice
 REASON_CAPACITY_LIMITED = "capacity_limited"  # squeezed out / infeasible
 REASON_ASLEEP = "asleep"  # scaled to zero; sized from gateway demand
 REASON_ERROR = "error"  # preparation or optimization failed this cycle
+# predictive scaling (inferno_tpu/forecast/):
+REASON_FORECAST_BOUND = "forecast_bound"  # forecast upper band, not observed λ, set N
+REASON_STABILIZATION_HOLD = "stabilization_hold"  # scale-down gated by the window
 
 REASON_CODES = (
     REASON_SLO_BOUND,
@@ -31,11 +34,18 @@ REASON_CODES = (
     REASON_CAPACITY_LIMITED,
     REASON_ASLEEP,
     REASON_ERROR,
+    REASON_FORECAST_BOUND,
+    REASON_STABILIZATION_HOLD,
 )
 
 # Profile-parameter provenance values
 PROVENANCE_CR = "cr"  # CR-carried static profile used as-is
 PROVENANCE_CORRECTED = "corrected"  # corrector-calibrated parameters
+
+# Sizing arrival-rate provenance values: which λ the sizing actually ran
+# against (forecast provenance for the predictive-scaling path)
+RATE_PROVENANCE_OBSERVED = "observed"  # the collector's observed λ
+RATE_PROVENANCE_FORECAST = "forecast"  # the forecast upper band exceeded it
 
 
 @dataclasses.dataclass
@@ -59,6 +69,17 @@ class DecisionRecord:
     profile_provenance: str = PROVENANCE_CR  # "cr" | "corrected"
     slo_ttft_ms: float = 0.0
     slo_itl_ms: float = 0.0
+    # predictive scaling (inferno_tpu/forecast/): the λ the sizing RAN
+    # against (max of observed and the forecast upper band when the
+    # feature is enabled; equal to arrival_rpm otherwise), and the
+    # forecast that produced it
+    sizing_rpm: float = 0.0
+    rate_provenance: str = RATE_PROVENANCE_OBSERVED  # "observed" | "forecast"
+    forecast_rpm: float = 0.0  # point estimate at the horizon
+    forecast_upper_rpm: float = 0.0  # rate + band (the sizing bound)
+    forecast_band_rpm: float = 0.0  # band half-width
+    forecast_horizon_s: float = 0.0  # replica spin-up latency (catalog)
+    forecast_burst: bool = False  # burst detector fired this cycle
 
     # -- the decision -------------------------------------------------------
     accelerator: str = ""

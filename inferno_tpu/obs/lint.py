@@ -29,10 +29,14 @@ def lint_registry(registry) -> list[str]:
 
 def build_controller_registry():
     """The full production metric catalog, exactly as main() assembles it:
-    the four actuation series (MetricsEmitter) plus the cycle-latency
-    histograms (CycleInstruments)."""
+    the four actuation series (MetricsEmitter), the cycle-latency
+    histograms (CycleInstruments), and the predictive-scaling forecast
+    gauges (ForecastInstruments — registered unconditionally, like the
+    Reconciler does, so the catalog is identical whether or not
+    PREDICTIVE_SCALING is enabled)."""
     from inferno_tpu.controller.metrics import (
         CycleInstruments,
+        ForecastInstruments,
         MetricsEmitter,
         Registry,
     )
@@ -40,6 +44,7 @@ def build_controller_registry():
     registry = Registry()
     MetricsEmitter(registry)
     CycleInstruments(registry)
+    ForecastInstruments(registry)
     return registry
 
 

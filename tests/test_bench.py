@@ -326,6 +326,27 @@ def test_compact_line_degrades_instead_of_raising(monkeypatch):
     assert doc["extra"]["full_payload"] == "bench_full.json"
 
 
+def test_predictive_scaling_report_block():
+    """ISSUE-4: the bench artifact carries the closed-loop
+    predictive-vs-reactive comparison, provenance-marked per controller
+    flavor, and the canonical scenario satisfies the acceptance ordering
+    (strictly fewer SLO-violation seconds at equal-or-lower cost) with
+    the BENCHED profile's λ_max, not just the test default's. Runs the
+    deterministic analytic loop directly — no emulator threads."""
+    prof = {"alpha": 18.0, "beta": 0.3, "gamma": 5.0, "delta": 0.02,
+            "max_batch": 64, "chips": 8}
+    block = bench.predictive_scaling_report(prof, "v5e-8")
+    assert block["spinup_s"] > 0
+    for flavor in ("canonical", "production_timing"):
+        cmp_ = block[flavor]
+        assert cmp_["reactive"]["provenance"] == "reactive"
+        assert cmp_["predictive"]["provenance"] == "predictive"
+        assert cmp_["predictive"]["slo_violation_s"] < cmp_["reactive"]["slo_violation_s"]
+    canonical = block["canonical"]
+    assert canonical["predictive"]["cost"] <= canonical["reactive"]["cost"]
+    json.dumps(block)  # strict-JSON serializable for bench_full.json
+
+
 def test_llama_70b_multihost_table(ns):
     """BASELINE config #5: the bench carries a 70B per-shape table over
     the 16-chip multi-host slices, every row marked derived (no on-chip

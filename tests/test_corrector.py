@@ -121,6 +121,60 @@ def test_prefill_hysteresis_matches_decode():
     assert pre != PRE
 
 
+def test_prefill_only_drift_activates_correction():
+    """ROADMAP r7 regression (direction 1): a prefill-only profile drift
+    — decode residual squarely in-band — must activate the prefill
+    correction on its own. The old code gated the gamma/delta check
+    behind the decode residual, so this drift was invisible."""
+    c = ProfileCorrector(window=8)
+    pred_itl = 5.0 + 0.1 * 8
+    pred_pf = 2.0 + 0.01 * 16 * 8
+    for _ in range(8):
+        c.observe("v", obs(8.0, pred_itl, ttft=1.5 * pred_pf))
+    dec, pre, state = c.corrected_parms("v", DEC, PRE)
+    assert state.active and state.prefill_active
+    assert not state.decode_active
+    assert state.prefill_ratio == pytest.approx(1.5, rel=0.03)
+    assert pre.gamma == pytest.approx(PRE.gamma * 1.5, rel=0.03)
+    # decode stays untouched: in-band residual, ratio 1.0
+    assert dec == DEC
+    assert state.decode_ratio == 1.0
+
+
+def test_decode_release_keeps_prefill_correction():
+    """ROADMAP r7 regression (direction 2): with both corrections
+    active, the decode residual returning in-band releases ONLY the
+    decode correction — a still-out-of-band prefill correction must
+    survive the same cycle (the old early-return dropped it)."""
+    c = ProfileCorrector(window=8)
+    pred_itl = 5.0 + 0.1 * 8
+    pred_pf = 2.0 + 0.01 * 16 * 8
+    # both phases 1.5x over: both activate
+    for _ in range(8):
+        c.observe("v", obs(8.0, 1.5 * pred_itl, ttft=1.5 * pred_pf))
+    _, _, state = c.corrected_parms("v", DEC, PRE)
+    assert state.decode_active and state.prefill_active
+
+    # decode telemetry recovers fully (1.02x, inside the sqrt release
+    # band); prefill stays 1.3x out-of-band
+    for _ in range(8):
+        c.observe("v", obs(8.0, 1.02 * pred_itl, ttft=1.3 * pred_pf))
+    dec, pre, state = c.corrected_parms("v", DEC, PRE)
+    assert not state.decode_active
+    assert dec == DEC  # decode correction released cleanly
+    assert state.active and state.prefill_active  # prefill held
+    assert state.prefill_ratio == pytest.approx(1.3, rel=0.03)
+    assert pre.gamma == pytest.approx(PRE.gamma * 1.3, rel=0.03)
+
+    # and the prefill release band is ITS OWN sqrt(band): once prefill
+    # telemetry recovers too, everything lets go
+    for _ in range(8):
+        c.observe("v", obs(8.0, 1.02 * pred_itl, ttft=1.05 * pred_pf))
+    dec, pre, state = c.corrected_parms("v", DEC, PRE)
+    assert not state.active
+    assert (dec, pre) == (DEC, PRE)
+
+
 def test_surrogate_refit_beats_ratio_on_nonlinear_truth():
     """True ITL bends quadratically; the linear CR profile underestimates
     at high batch. The surrogate-refit linearization over the observed

@@ -9,12 +9,28 @@ from inferno_tpu.obs.lint import build_controller_registry, lint_registry, main
 def test_production_catalog_is_clean():
     registry = build_controller_registry()
     names = {name for name, _, _ in registry.catalog()}
-    # the four actuation series plus the four cycle-latency histograms
-    assert len(names) == 8
+    # the four actuation series, the four cycle-latency histograms, and
+    # the three predictive-scaling forecast gauges
+    assert len(names) == 11
     assert {"inferno_desired_replicas", "inferno_cycle_duration_seconds",
             "inferno_variant_analysis_seconds", "inferno_solver_seconds",
             "inferno_prom_scrape_seconds"} <= names
     assert lint_registry(registry) == []
+
+
+def test_forecast_series_in_catalog():
+    """The forecast series ride the same prefix + help enforcement as
+    the rest of the catalog, and register UNCONDITIONALLY (the catalog
+    must not depend on whether PREDICTIVE_SCALING is enabled)."""
+    registry = build_controller_registry()
+    catalog = {name: (help_, kind) for name, help_, kind in registry.catalog()}
+    for name in ("inferno_forecast_arrival_rpm", "inferno_forecast_band_rpm",
+                 "inferno_forecast_abs_error_rpm"):
+        assert name in catalog, name
+        help_, kind = catalog[name]
+        assert kind == "gauge"
+        assert help_.strip()
+        assert name.startswith("inferno_")
 
 
 def test_lint_flags_missing_prefix_and_help():
