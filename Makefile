@@ -5,9 +5,9 @@ PYTHON ?= python
 IMG ?= inferno-tpu-autoscaler:latest
 CLUSTER ?= inferno-tpu
 
-.PHONY: all test test-unit test-e2e test-apiserver bench native lint \
-        lint-metrics manifests-sync docker-build deploy-kind deploy \
-        undeploy clean
+.PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
+        native lint lint-metrics manifests-sync docker-build deploy-kind \
+        deploy undeploy clean
 
 all: native test
 
@@ -37,6 +37,12 @@ test-apiserver:
 # Benchmark: one JSON line (fleet sizing cycle vs reference algorithm).
 bench:
 	$(PYTHON) bench.py
+
+# Synthetic 200-variant reconcile-cycle benchmark: serial per-variant
+# collection vs coalesced queries + concurrency + sizing cache
+# (docs/performance.md). One JSON line on stdout.
+bench-cycle:
+	$(PYTHON) bench.py --cycle
 
 # Build the native C++ solver in place (also built on demand at import).
 native:

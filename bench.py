@@ -478,6 +478,106 @@ def _device_roundtrip_ms() -> float:
     return statistics.median(xs)
 
 
+def reconcile_cycle_bench(n_variants: int = 200, repeats: int = 3) -> dict:
+    """Synthetic fleet-scale RECONCILE benchmark (ISSUE-5): unlike
+    fleet_cycle_metrics (which times only the solve math), this drives
+    whole `Reconciler.run_cycle()`s — Kube reads, Prometheus collection
+    over a real MiniProm HTTP listener, sizing, actuation writes — for an
+    N-variant fleet, comparing the serial path (per-variant queries, no
+    pool, no cache) against the optimized path (coalesced queries +
+    RECONCILE_CONCURRENCY + input-signature sizing cache). Reports
+    wall-clock per cycle and Prometheus query counts with provenance:
+    the I/O wall the solve-only number never sees."""
+    from inferno_tpu.controller.promclient import HttpPromClient, PromConfig
+    from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+    from inferno_tpu.emulator.miniprom import MiniProm
+    from inferno_tpu.testing.fleet import (
+        CONFIG_NS,
+        FLEET_NS,
+        fleet_cluster,
+        fleet_targets,
+    )
+
+    prom_srv = MiniProm(
+        [(t, {"namespace": FLEET_NS}) for t in fleet_targets(n_variants)],
+        scrape_interval=3600.0,  # scrapes driven below, not by the loop
+        window_seconds=3600.0,
+    )
+    prom_srv.scrape_once()
+    time.sleep(0.2)
+    prom_srv.scrape_once()
+    prom_srv.start()
+    # silence per-decision INFO logs for the bench window: N variants x
+    # cycles x configs of JSON log lines would swamp the one line the
+    # driver's tail capture needs (the round-4 postmortem failure mode)
+    import logging as _logging
+
+    rec_log = _logging.getLogger("inferno.reconciler")
+    prev_level = rec_log.level
+    rec_log.setLevel(_logging.WARNING)
+    try:
+        def run(label: str, **cfg) -> dict:
+            cluster = fleet_cluster(n_variants)
+            rec = Reconciler(
+                kube=cluster,
+                prom=HttpPromClient(
+                    PromConfig(base_url=prom_srv.url, allow_http=True)
+                ),
+                config=ReconcilerConfig(
+                    config_namespace=CONFIG_NS, compute_backend="scalar",
+                    **cfg,
+                ),
+            )
+            # re-silence: Reconciler.__init__ calls get_logger, which
+            # resets the shared logger back to the LOG_LEVEL env level
+            rec_log.setLevel(_logging.WARNING)
+            times, reports = [], []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                reports.append(rec.run_cycle())
+                times.append((time.perf_counter() - t0) * 1000.0)
+            rec.close()  # join the persistent collect/apply pool
+            last = reports[-1]
+            return {
+                "config": label,
+                "cycle_ms": round(min(times), 1),
+                "cycle_ms_all": [round(t, 1) for t in times],
+                "prom_queries_per_cycle": last.prom_queries,
+                "variants_applied": last.variants_applied,
+                "sizing_cache_hits": last.sizing_cache_hits,
+                "errors": len(last.errors),
+            }
+
+        serial = run(
+            "serial (per-variant queries, concurrency 1, cache off)",
+            grouped_collection=False,
+        )
+        optimized = run(
+            "optimized (coalesced queries, concurrency 16, sizing cache)",
+            grouped_collection=True, reconcile_concurrency=16,
+            sizing_cache=True, sizing_cache_tolerance=0.05,
+        )
+    finally:
+        rec_log.setLevel(prev_level)
+        prom_srv.stop()
+    return {
+        "n_variants": n_variants,
+        "repeats": repeats,
+        "serial": serial,
+        "optimized": optimized,
+        "speedup": round(serial["cycle_ms"] / max(optimized["cycle_ms"], 1e-6), 2),
+        "query_reduction": round(
+            serial["prom_queries_per_cycle"]
+            / max(optimized["prom_queries_per_cycle"], 1), 1
+        ),
+        "provenance": (
+            "miniprom-http-sockets/in-memory-cluster/scalar-backend: "
+            "measures the collection+actuation I/O wall, not the solve "
+            "(fleet_cycle covers that)"
+        ),
+    }
+
+
 def fleet_cycle_metrics(full: bool = True) -> dict:
     spec = build_spec(64)  # 64 variants x 8 shapes = 512 lanes
     opt = spec.optimizer
@@ -1064,7 +1164,8 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
                        measured_p99: dict | None = None,
                        calibrated: dict | None = None,
                        trace: dict | None = None,
-                       predictive: dict | None = None) -> dict:
+                       predictive: dict | None = None,
+                       reconcile_cycle: dict | None = None) -> dict:
     """Everything the bench measures, in one document — written to
     `bench_full.json`, NOT printed (the printed line is `compact_line`)."""
     return {
@@ -1115,12 +1216,18 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
                     "(tests/test_e2e_llama70b.py)",
         },
         "fleet_cycle": cycles,
+        # whole-reconcile serial-vs-optimized I/O benchmark (ISSUE-5):
+        # coalesced collection + concurrency + sizing cache against the
+        # per-variant serial path, miniprom-backed
+        **({"reconcile_cycle": reconcile_cycle} if reconcile_cycle else {}),
     }
 
 
 # optional `extra` fields in drop order on a 1024-byte overflow: least
 # headline-critical first (the full payload always carries everything)
 _COMPACT_DROP_ORDER = (
+    "reconcile_speedup",
+    "reconcile_query_reduction",
     "fleet_cycle_platform",
     "fleet_cycle_ms",
     "a100_usd_per_mtok",
@@ -1136,7 +1243,8 @@ _COMPACT_DROP_ORDER = (
 
 def compact_line(ns: dict, cycles: dict, tpu_probe: dict,
                  measured_p99: dict | None = None,
-                 calibrated: dict | None = None) -> str:
+                 calibrated: dict | None = None,
+                 reconcile_cycle: dict | None = None) -> str:
     """The ONE printed JSON line. Round-4 postmortem: the driver captures
     only a tail window of stdout, and round 4's ~4 KB single line was cut
     mid-object (`BENCH_r04.json parsed: null`) — a benchmark whose number
@@ -1157,6 +1265,9 @@ def compact_line(ns: dict, cycles: dict, tpu_probe: dict,
         "tpu_reachable": tpu_probe.get("reachable", False),
         "fleet_cycle_platform": cycles["platform"],
         "fleet_cycle_ms": cycles["auto_selected_ms"],
+        **({"reconcile_speedup": reconcile_cycle["speedup"],
+            "reconcile_query_reduction": reconcile_cycle["query_reduction"]}
+           if reconcile_cycle and "speedup" in reconcile_cycle else {}),
         **({"p99_ttft_measured_ms": measured_p99["p99_ttft_ms"],
             "p99_meets_slo": measured_p99["meets_slo"]}
            if measured_p99 else {}),
@@ -1205,7 +1316,15 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="skip the 4096-lane scaling row (CI smoke)")
+    ap.add_argument("--cycle", action="store_true",
+                    help="run ONLY the synthetic reconcile-cycle benchmark "
+                         "(make bench-cycle) and print its JSON")
+    ap.add_argument("--cycle-variants", type=int, default=200,
+                    help="fleet size for the reconcile-cycle benchmark")
     args = ap.parse_args()
+    if args.cycle:
+        print(json.dumps(reconcile_cycle_bench(args.cycle_variants)))
+        return
     from inferno_tpu.obs import Tracer
 
     tracer = Tracer("bench")
@@ -1242,14 +1361,26 @@ def main() -> None:
             sp.set(error=str(e))
     with tracer.span("fleet-cycle-timing"):
         cycles = fleet_cycle_metrics(full=not args.quick)
+    # whole-reconcile I/O benchmark (ISSUE-5): guarded like the other
+    # optional phases — a regression here must never abort the headline
+    with tracer.span("reconcile-cycle-bench") as sp:
+        try:
+            reconcile_cycle = reconcile_cycle_bench(
+                50 if args.quick else args.cycle_variants
+            )
+        except Exception as e:  # noqa: BLE001 — artifact must survive
+            reconcile_cycle = {"error": f"{type(e).__name__}: {e}"}
+            sp.set(error=str(e))
     Path(FULL_PAYLOAD_PATH).write_text(
         json.dumps(build_full_payload(ns, cycles, tpu_probe, measured,
                                       calibrated,
                                       trace=tracer.finish().to_dict(),
-                                      predictive=predictive),
+                                      predictive=predictive,
+                                      reconcile_cycle=reconcile_cycle),
                    indent=1) + "\n"
     )
-    print(compact_line(ns, cycles, tpu_probe, measured, calibrated))
+    print(compact_line(ns, cycles, tpu_probe, measured, calibrated,
+                       reconcile_cycle))
 
 
 if __name__ == "__main__":

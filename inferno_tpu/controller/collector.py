@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import re
 import time
 
 from inferno_tpu.controller.crd import (
@@ -264,5 +265,221 @@ def collect_current_alloc(
             arrival_rate=arrival,
             avg_input_tokens=avg_in,
             avg_output_tokens=avg_out,
+        ),
+    )
+
+
+# -- coalesced (grouped) collection ------------------------------------------
+#
+# The per-variant path above issues ~6 queries per variant per cycle: at
+# "hundreds of variants" scale the cycle is O(variants x queries) round
+# trips. The grouped path issues ONE PromQL per metric, selecting every
+# active variant with regex matchers and splitting per variant with
+# `by (<model label>, namespace)` — Q queries total, fanned back out to
+# per-variant CurrentAllocs. A variant missing from the grouped presence
+# probe falls back to its per-variant queries (emulator setups without a
+# namespace label, engines mid-rollout), so the grouped path is an
+# optimization, never a new failure mode.
+
+
+def _promql_quote(regex: str) -> str:
+    """Escape a regex for embedding in a PromQL double-quoted string.
+
+    PromQL string literals follow Go escape rules, so the backslashes
+    `re.escape` emits (`\\.`, `\\-`) are INVALID escape sequences at the
+    string layer — real Prometheus rejects the whole query with "unknown
+    escape sequence". Doubling them makes the string literal unescape
+    back to the intended regex."""
+    return regex.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _group_selector(engine: EngineMetrics, pairs: set[tuple[str, str]]) -> str:
+    """Regex label selector covering all active (model, namespace) pairs.
+
+    Values are regex-escaped (model ids routinely contain `.` and `/`),
+    then string-escaped for the PromQL literal; Prometheus anchors label
+    regexes, so alternation is exact-match per value. The selector is the
+    cross product of models x namespaces — over-selection is harmless
+    because the fan-out only reads the keys it asked for."""
+    models = _promql_quote("|".join(sorted({re.escape(m) for m, _ in pairs})))
+    namespaces = _promql_quote(
+        "|".join(sorted({re.escape(ns) for _, ns in pairs}))
+    )
+    return (
+        f'{{{engine.model_label}=~"{models}",'
+        f'{LABEL_NAMESPACE}=~"{namespaces}"}}'
+    )
+
+
+def grouped_queries(engine: EngineMetrics, pairs: set[tuple[str, str]]) -> dict[str, str]:
+    """The coalesced per-metric PromQL, keyed by FleetSamples field name.
+    ~Q queries regardless of variant count (7 with a max-batch metric)."""
+    sel = _group_selector(engine, pairs)
+    by = f" by ({engine.model_label}, {LABEL_NAMESPACE})"
+
+    def ratio(num: str, den: str) -> str:
+        return (
+            f"sum(rate({num}{sel}[1m])){by}"
+            f"/sum(rate({den}{sel}[1m])){by}"
+        )
+
+    queries = {
+        "running": f"sum({engine.num_requests_running}{sel}){by}",
+        "arrival": f"sum(rate({engine.request_success_total}{sel}[1m])){by}",
+        "avg_in": ratio(engine.prompt_tokens_sum, engine.prompt_tokens_count),
+        "avg_out": ratio(engine.generation_tokens_sum, engine.generation_tokens_count),
+        "ttft": ratio(engine.ttft_seconds_sum, engine.ttft_seconds_count),
+        "itl": ratio(engine.tpot_seconds_sum, engine.tpot_seconds_count),
+    }
+    if engine.max_batch_metric:
+        queries["max_batch"] = f"max({engine.max_batch_metric}{sel}){by}"
+    return queries
+
+
+@dataclasses.dataclass
+class FleetSamples:
+    """Per-(model, namespace) values from one cycle's coalesced queries.
+
+    `running` doubles as the presence/freshness probe: a variant whose
+    key is absent here takes the per-variant fallback path. Timestamps
+    ride along so the staleness check survives coalescing (real
+    Prometheus instant vectors already exclude series beyond the
+    staleness lookback, which equals STALENESS_LIMIT_SECONDS)."""
+
+    engine: EngineMetrics
+    running: dict[tuple[str, str], tuple[float, float]] = dataclasses.field(
+        default_factory=dict
+    )  # key -> (summed value, newest timestamp)
+    arrival: dict[tuple[str, str], float] = dataclasses.field(default_factory=dict)
+    avg_in: dict[tuple[str, str], float] = dataclasses.field(default_factory=dict)
+    avg_out: dict[tuple[str, str], float] = dataclasses.field(default_factory=dict)
+    ttft: dict[tuple[str, str], float] = dataclasses.field(default_factory=dict)
+    itl: dict[tuple[str, str], float] = dataclasses.field(default_factory=dict)
+    max_batch: dict[tuple[str, str], float] = dataclasses.field(default_factory=dict)
+    queries_issued: int = 0
+
+    def has(self, model: str, namespace: str) -> bool:
+        return (model, namespace) in self.running
+
+
+def _fan_out(
+    engine: EngineMetrics, samples: list[Sample]
+) -> dict[tuple[str, str], tuple[float, float]]:
+    """Grouped vector -> {(model, namespace): (value, newest ts)}.
+    Samples missing either grouping label (an emulator exposition with no
+    namespace label) are dropped — those variants take the fallback."""
+    out: dict[tuple[str, str], tuple[float, float]] = {}
+    for s in samples:
+        model = s.labels.get(engine.model_label)
+        ns = s.labels.get(LABEL_NAMESPACE)
+        if model is None or ns is None:
+            continue
+        prev = out.get((model, ns))
+        if prev is None:
+            out[(model, ns)] = (fix_value(s.value), s.timestamp)
+        else:  # defensive: one group should appear once per vector
+            out[(model, ns)] = (prev[0] + fix_value(s.value),
+                                max(prev[1], s.timestamp))
+    return out
+
+
+def collect_fleet_samples(
+    prom: PromClient, engine: EngineMetrics, pairs: set[tuple[str, str]]
+) -> FleetSamples | None:
+    """Issue the ~Q coalesced queries for all active variants. Returns
+    None when any grouped query fails (a Prometheus outage fails in Q
+    queries, not Q x V; callers then run the per-variant path whose
+    per-variant PromErrors keep today's skip/error isolation)."""
+    if not pairs:
+        return None
+    fleet = FleetSamples(engine=engine)
+    try:
+        for field, promql in grouped_queries(engine, pairs).items():
+            table = _fan_out(engine, prom.query(promql))
+            fleet.queries_issued += 1
+            if field == "running":
+                fleet.running = table
+            else:
+                getattr(fleet, field).update(
+                    {k: v for k, (v, _ts) in table.items()}
+                )
+    except PromError:
+        return None
+    return fleet
+
+
+def validate_from_fleet(
+    fleet: FleetSamples, model: str, namespace: str
+) -> MetricsValidation | None:
+    """MetricsValidation from the coalesced presence probe; None when the
+    variant is absent from the grouped response (caller falls back to
+    validate_metrics_availability, which keeps the namespace-less
+    emulator fallback and the exact per-variant messages)."""
+    entry = fleet.running.get((model, namespace))
+    if entry is None:
+        return None
+    value, ts = entry
+    age = time.time() - ts
+    if age > STALENESS_LIMIT_SECONDS:
+        return MetricsValidation(
+            False,
+            REASON_METRICS_STALE,
+            f"{fleet.engine.name} metrics for model '{model}' are stale "
+            f"(last update {age:.0f}s ago).",
+        )
+    return MetricsValidation(
+        True,
+        REASON_METRICS_FOUND,
+        f"{fleet.engine.name} metrics are available and fresh",
+        running=value,
+    )
+
+
+def collect_alloc_from_fleet(
+    fleet: FleetSamples,
+    va: VariantAutoscaling,
+    workload,
+    accelerator_cost: float,
+) -> CurrentAlloc | None:
+    """CurrentAlloc from the coalesced tables — the fan-out counterpart
+    of collect_current_alloc, zero additional queries. None when the
+    presence probe never saw the variant (fallback path). A missing
+    per-metric group with the variant present means the underlying rate
+    is empty — the same 0.0 an empty per-variant vector produces."""
+    ns = workload.namespace or va.namespace
+    model = va.spec.model_id
+    key = (model, ns)
+    if key not in fleet.running:
+        return None
+
+    def val(table: dict[tuple[str, str], float]) -> float:
+        return fix_value(table.get(key, 0.0))
+
+    replicas = workload.replicas
+    accelerator = va.labels.get(ACCELERATOR_LABEL, "")
+    # max batch preference order matches _observed_max_batch: the grouped
+    # engine-reported value, the CR profile for the current shape, the
+    # constant fallback. (No namespace-less retry here: a variant present
+    # in the grouped probe exposes namespaced series.)
+    max_batch = int(val(fleet.max_batch))
+    if max_batch <= 0:
+        max_batch = 0
+        for prof in va.spec.accelerators:
+            if prof.acc == accelerator and prof.max_batch_size > 0:
+                max_batch = prof.max_batch_size
+                break
+        if max_batch <= 0:
+            max_batch = DEFAULT_MAX_BATCH
+    return CurrentAlloc(
+        accelerator=accelerator,
+        num_replicas=replicas,
+        max_batch=max_batch,
+        variant_cost=replicas * accelerator_cost,
+        itl_average=val(fleet.itl) * 1000.0,
+        ttft_average=val(fleet.ttft) * 1000.0,
+        load=LoadProfile(
+            arrival_rate=val(fleet.arrival) * 60.0,  # req/sec -> req/min
+            avg_input_tokens=val(fleet.avg_in),
+            avg_output_tokens=val(fleet.avg_out),
         ),
     )

@@ -42,6 +42,20 @@ controller.go:516-582):
                                 metrics listener retains for
                                 /debug/decisions (default 32;
                                 docs/observability.md)
+  RECONCILE_CONCURRENCY         bounded worker pool for per-variant collect
+                                and actuation I/O (default 1 = serial;
+                                docs/performance.md)
+  GROUPED_COLLECTION            true|false (default true): coalesce the
+                                collector's Prometheus queries into one
+                                per metric for the whole fleet; variants
+                                missing from a grouped response fall back
+                                to per-variant queries
+  SIZING_CACHE                  true|false (default false): reuse candidate
+                                allocations for variants whose sizing
+                                inputs are unchanged since last cycle
+  SIZING_CACHE_TOLERANCE        relative arrival-rate tolerance for sizing-
+                                cache hits (default 0.02 = 2%)
+  PROMETHEUS_QUERY_TIMEOUT      per-query timeout in seconds (default 30)
 """
 
 from __future__ import annotations
@@ -70,6 +84,9 @@ def prom_config_from_env():
         client_key_file=os.environ.get("PROMETHEUS_CLIENT_KEY_PATH", ""),
         insecure_skip_verify=env_bool("PROMETHEUS_TLS_INSECURE_SKIP_VERIFY"),
         allow_http=env_bool("PROMETHEUS_ALLOW_HTTP"),
+        query_timeout_seconds=float(
+            os.environ.get("PROMETHEUS_QUERY_TIMEOUT", "30") or 30
+        ),
     )
 
 
@@ -145,6 +162,15 @@ def main() -> int:
         scale_down_stabilization_s=float(
             os.environ.get("SCALE_DOWN_STABILIZATION_SECONDS", "0") or 0
         ),
+        # fleet-scale cycle knobs (docs/performance.md)
+        reconcile_concurrency=int(
+            os.environ.get("RECONCILE_CONCURRENCY", "1") or 1
+        ),
+        grouped_collection=env_bool("GROUPED_COLLECTION", True),
+        sizing_cache=env_bool("SIZING_CACHE"),
+        sizing_cache_tolerance=float(
+            os.environ.get("SIZING_CACHE_TOLERANCE", "0.02") or 0.02
+        ),
     )
     rec = Reconciler(
         kube=kube, prom=prom, config=config, emitter=emitter, trace_buffer=traces
@@ -198,6 +224,7 @@ def main() -> int:
         watcher.stop()
         if elector:
             elector.stop()
+        rec.close()  # join the persistent collect/apply worker pool
         health.stop()
         server.stop()
     return 0

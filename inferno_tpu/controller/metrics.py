@@ -44,17 +44,22 @@ class _Series:
         self.help = help_
         self.kind = kind  # "gauge" | "counter"
         self.values: dict[tuple, tuple[dict[str, str], float]] = {}
+        # mutation lock: the reconciler's bounded-concurrency pipeline
+        # emits from pool workers, and inc() is a read-modify-write
+        self._lock = threading.Lock()
 
     def _key(self, labels: dict[str, str]) -> tuple:
         return tuple(sorted(labels.items()))
 
     def set(self, labels: dict[str, str], value: float) -> None:
-        self.values[self._key(labels)] = (labels, value)
+        with self._lock:
+            self.values[self._key(labels)] = (labels, value)
 
     def inc(self, labels: dict[str, str], by: float = 1.0) -> None:
-        key = self._key(labels)
-        old = self.values.get(key, (labels, 0.0))[1]
-        self.values[key] = (labels, old + by)
+        with self._lock:
+            key = self._key(labels)
+            old = self.values.get(key, (labels, 0.0))[1]
+            self.values[key] = (labels, old + by)
 
     def get(self, labels: dict[str, str]) -> float | None:
         v = self.values.get(self._key(labels))
@@ -101,29 +106,33 @@ class _Histogram:
         self.buckets = tuple(float(b) for b in buckets)
         # label key -> (labels, per-bucket counts (non-cumulative), sum, count)
         self.values: dict[tuple, tuple[dict[str, str], list[int], float, int]] = {}
+        # observe() is read-modify-write; pool workers observe concurrently
+        self._lock = threading.Lock()
 
     def _key(self, labels: dict[str, str]) -> tuple:
         return tuple(sorted(labels.items()))
 
     def observe(self, labels: dict[str, str], value: float) -> None:
-        key = self._key(labels)
-        entry = self.values.get(key)
-        if entry is None:
-            entry = (dict(labels), [0] * (len(self.buckets) + 1), 0.0, 0)
-        lbls, counts, total, n = entry
-        # copy-on-write: a concurrent /metrics render snapshots the stored
-        # tuples, so mutating the shared counts list in place could show a
-        # finite bucket ahead of _count (+Inf) — an invalid cumulative
-        # exposition. A fresh list + atomic dict assignment keeps every
-        # rendered view internally consistent (old or new, never mixed).
-        counts = list(counts)
-        # last slot is the +Inf overflow bucket
-        idx = next(
-            (i for i, b in enumerate(self.buckets) if value <= b),
-            len(self.buckets),
-        )
-        counts[idx] += 1
-        self.values[key] = (lbls, counts, total + value, n + 1)
+        with self._lock:
+            key = self._key(labels)
+            entry = self.values.get(key)
+            if entry is None:
+                entry = (dict(labels), [0] * (len(self.buckets) + 1), 0.0, 0)
+            lbls, counts, total, n = entry
+            # copy-on-write: a concurrent /metrics render snapshots the
+            # stored tuples, so mutating the shared counts list in place
+            # could show a finite bucket ahead of _count (+Inf) — an
+            # invalid cumulative exposition. A fresh list + atomic dict
+            # assignment keeps every rendered view internally consistent
+            # (old or new, never mixed).
+            counts = list(counts)
+            # last slot is the +Inf overflow bucket
+            idx = next(
+                (i for i, b in enumerate(self.buckets) if value <= b),
+                len(self.buckets),
+            )
+            counts[idx] += 1
+            self.values[key] = (lbls, counts, total + value, n + 1)
 
     def remove(self, labels: dict[str, str]) -> None:
         self.values.pop(self._key(labels), None)
@@ -284,6 +293,20 @@ METRIC_VARIANT_ANALYSIS = "inferno_variant_analysis_seconds"
 METRIC_SOLVER_LATENCY = "inferno_solver_seconds"
 METRIC_PROM_SCRAPE = "inferno_prom_scrape_seconds"
 
+# Fleet-scale cycle instrumentation (ISSUE-5): Prometheus query volume
+# (the coalesced collector turns Q x V round trips into ~Q — this
+# counter is how you SEE that), per-cycle sizing-cache outcome counts
+# (labelled result="hit"|"miss"), and the collect-pool width actually
+# used per cycle.
+METRIC_PROM_QUERIES = "inferno_cycle_prom_queries_total"
+METRIC_SIZING_CACHE = "inferno_sizing_cache_lookups"
+METRIC_COLLECT_CONCURRENCY = "inferno_collect_concurrency"
+LABEL_RESULT = "result"
+
+# Collect-pool width buckets: powers of two up to the practical ceiling
+# of RECONCILE_CONCURRENCY (a thread per in-flight variant collect).
+CONCURRENCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
 
 class CycleInstruments:
     """Latency histograms for the reconcile loop: whole-cycle duration,
@@ -310,6 +333,20 @@ class CycleInstruments:
             METRIC_PROM_SCRAPE,
             "Prometheus query latency for load/metrics collection",
         )
+        self.prom_queries = self.registry.counter(
+            METRIC_PROM_QUERIES,
+            "Prometheus queries issued by reconcile cycles",
+        )
+        self.cache_lookups = self.registry.gauge(
+            METRIC_SIZING_CACHE,
+            "Sizing-cache lookups of the last reconcile cycle by result "
+            "(hit: candidate allocations reused; miss: variant re-solved)",
+        )
+        self.collect_concurrency = self.registry.histogram(
+            METRIC_COLLECT_CONCURRENCY,
+            "Concurrent collect workers used per reconcile cycle",
+            buckets=CONCURRENCY_BUCKETS,
+        )
 
     def observe_cycle(self, seconds: float) -> None:
         self.cycle.observe({}, seconds)
@@ -324,6 +361,17 @@ class CycleInstruments:
 
     def observe_scrape(self, seconds: float) -> None:
         self.scrape.observe({}, seconds)
+
+    def count_prom_queries(self, n: int) -> None:
+        if n > 0:
+            self.prom_queries.inc({}, float(n))
+
+    def set_cache_outcome(self, hits: int, misses: int) -> None:
+        self.cache_lookups.set({LABEL_RESULT: "hit"}, float(hits))
+        self.cache_lookups.set({LABEL_RESULT: "miss"}, float(misses))
+
+    def observe_collect_concurrency(self, workers: int) -> None:
+        self.collect_concurrency.observe({}, float(workers))
 
     def prune_variants(self, active: set[tuple[str, str]]) -> None:
         """Drop per-variant analysis series of variants no longer managed

@@ -15,6 +15,7 @@ marks OptimizationReady=False on all VAs and retries next cycle.
 
 from __future__ import annotations
 
+import concurrent.futures
 import dataclasses
 import json
 import logging
@@ -37,8 +38,13 @@ from inferno_tpu.config.types import (
 )
 from inferno_tpu.controller.actuator import Actuator
 from inferno_tpu.controller.collector import (
+    FleetSamples,
+    MetricsValidation,
+    collect_alloc_from_fleet,
     collect_current_alloc,
+    collect_fleet_samples,
     collect_sleeping_alloc,
+    validate_from_fleet,
     validate_metrics_availability,
 )
 from inferno_tpu.controller.crd import (
@@ -70,6 +76,7 @@ from inferno_tpu.obs import (
     REASON_FORECAST_BOUND,
     REASON_SLO_BOUND,
     REASON_STABILIZATION_HOLD,
+    SIZING_PROVENANCE_CACHED,
     DecisionRecord,
     Span,
     TraceBuffer,
@@ -161,6 +168,16 @@ class ReconcilerConfig:
                 f"scale_down_stabilization_s must be >= 0, "
                 f"got {self.scale_down_stabilization_s}"
             )
+        if self.reconcile_concurrency < 1:
+            raise ValueError(
+                f"reconcile_concurrency must be >= 1, "
+                f"got {self.reconcile_concurrency}"
+            )
+        if self.sizing_cache_tolerance < 0:
+            raise ValueError(
+                f"sizing_cache_tolerance must be >= 0, "
+                f"got {self.sizing_cache_tolerance}"
+            )
         engine_for(self.engine)  # raise at config time on unknown engines
         if not self.keep_accelerator and self.direct_scale:
             # direct_scale only patches replica counts on the EXISTING
@@ -203,6 +220,25 @@ class ReconcilerConfig:
     # window should usually stay 0 (double-gating delays legitimate
     # scale-down twice)
     scale_down_stabilization_s: float = 0.0
+    # -- fleet-scale cycle knobs (ISSUE-5, docs/performance.md) --------------
+    # bounded concurrency for the per-variant collect stage and _apply's
+    # Kube patches (env RECONCILE_CONCURRENCY). 1 = today's serial
+    # behavior exactly; per-variant failures stay isolated either way,
+    # and CycleReport records/spans keep variant-list order regardless
+    # of completion order
+    reconcile_concurrency: int = 1
+    # coalesced Prometheus collection (env GROUPED_COLLECTION): one query
+    # per metric covering every active variant, fanned back out per
+    # variant; a variant missing from the grouped response falls back to
+    # its per-variant queries, so disabling only costs round trips
+    grouped_collection: bool = True
+    # input-signature sizing cache (env SIZING_CACHE, default off):
+    # variants whose sizing inputs are unchanged since last cycle (λ
+    # within sizing_cache_tolerance relative; profile parms incl.
+    # corrector output, SLOs, capacity, shape set exact) replay their
+    # candidate allocations instead of re-solving
+    sizing_cache: bool = False
+    sizing_cache_tolerance: float = 0.02
 
 
 @dataclasses.dataclass
@@ -221,6 +257,12 @@ class CycleReport:
     optimization_ok: bool = True
     solver_ms: float = 0.0
     analysis_ms: float = 0.0
+    # fleet-scale cycle telemetry (ISSUE-5): Prometheus queries issued
+    # this cycle (the coalesced collector's ~Q vs the serial path's
+    # Q x V), and the sizing cache's per-cycle outcome counts
+    prom_queries: int = 0
+    sizing_cache_hits: int = 0
+    sizing_cache_misses: int = 0
     errors: list[str] = dataclasses.field(default_factory=list)
     # one DecisionRecord per VA seen this cycle (obs/decision.py): the
     # per-variant sizing rationale — observed λ, provenance, λ_max, SLO
@@ -229,6 +271,48 @@ class CycleReport:
     # root span of the cycle trace (obs/trace.py): collect -> analyze
     # (one child per variant) -> solve -> actuate
     trace: Span | None = None
+
+
+class _CountingProm:
+    """Per-cycle PromClient view counting every query issued — feeds
+    CycleReport.prom_queries and inferno_cycle_prom_queries_total (the
+    instrument that makes the coalesced collector's Q-vs-QxV win, or a
+    fallback regression, visible). Wraps whatever self.prom currently is
+    at cycle start, so tests swapping the client keep working."""
+
+    def __init__(self, inner: PromClient):
+        self.inner = inner
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def query(self, promql: str):
+        with self._lock:
+            self.count += 1
+        return self.inner.query(promql)
+
+    def healthy(self) -> bool:
+        return self.inner.healthy()
+
+
+@dataclasses.dataclass
+class _Collected:
+    """Per-variant outcome of the collect stage (the I/O half of what
+    used to be one monolithic prepare()): everything the serial assembly
+    stage needs to finish the variant deterministically. Workers only
+    touch per-variant state (the VA object, its DecisionRecord, this
+    container), never the shared spec/classes/report."""
+
+    rec: DecisionRecord
+    ok: bool = False
+    errors: list[str] = dataclasses.field(default_factory=list)
+    class_name: str = ""
+    target: Any = None
+    matching_profiles: list = dataclasses.field(default_factory=list)
+    workload: Any = None
+    validation: MetricsValidation | None = None
+    asleep: bool = False
+    current: Any = None  # CurrentAlloc
+    elapsed_s: float = 0.0  # worker wall time (per-variant analysis metric)
 
 
 class Reconciler:
@@ -307,6 +391,22 @@ class Reconciler:
             )
         else:
             self.stabilizer = None
+        # input-signature sizing cache (controller/sizing_cache.py):
+        # replay candidate allocations for variants whose sizing inputs
+        # are unchanged since the previous cycle
+        if self.config.sizing_cache:
+            from inferno_tpu.controller.sizing_cache import SizingCache
+
+            self.sizing_cache = SizingCache(self.config.sizing_cache_tolerance)
+        else:
+            self.sizing_cache = None
+        # persistent worker pool shared by the collect and apply stages
+        # (reconcile_concurrency > 1 only; lazily created, kept across
+        # cycles). Tearing a pool down every cycle would kill the worker
+        # threads — and with them HttpPromClient's per-thread keep-alive
+        # connections — re-paying thread spawn + TCP/TLS handshakes
+        # every cycle, exactly what the connection cache amortizes.
+        self._pool: concurrent.futures.ThreadPoolExecutor | None = None
         # forecast/stabilizer timestamp source — injectable so tests can
         # step cycles at a controlled cadence instead of real time
         self.clock: Callable[[], float] = time.monotonic
@@ -322,6 +422,21 @@ class Reconciler:
     def poke(self) -> None:
         """Request an immediate reconcile (watch-event trigger)."""
         self._wake.set()
+
+    def _executor(self) -> concurrent.futures.ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.config.reconcile_concurrency,
+                thread_name_prefix="inferno-reconcile",
+            )
+        return self._pool
+
+    def close(self) -> None:
+        """Release the persistent worker pool (main() on shutdown; safe to
+        call on a never-pooled or already-closed reconciler)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
 
     # -- config reading -----------------------------------------------------
 
@@ -490,50 +605,57 @@ class Reconciler:
         except KubeError:
             pass  # retried next cycle
 
-    def prepare(
+    def _collect_variant(
         self,
         va: VariantAutoscaling,
         engine: EngineMetrics,
-        classes: list[ServiceClassSpec],
+        prom: PromClient,
+        fleet: FleetSamples | None,
+        slo: tuple[str, ModelTarget] | None,
         accelerators: dict[str, AcceleratorSpec],
-        spec: SystemSpec,
-        report: CycleReport,
-    ) -> bool:
-        """Prepare one VA into the system spec
-        (reference prepareVariantAutoscalings: controller.go:218-335).
-        Returns True if the VA was added as a server. Every call appends a
-        DecisionRecord to the report — partial (reason `error` + detail)
-        when preparation fails, completed by _apply once a decision
-        exists."""
+    ) -> _Collected:
+        """The I/O half of variant preparation (reference
+        prepareVariantAutoscalings: controller.go:218-335): workload
+        lookup, owner reference, metrics validation, load collection.
+        Runs on a pool worker when RECONCILE_CONCURRENCY > 1 and touches
+        only per-variant state; any failure lands in the returned
+        container (the variant's skip/error path), never the cycle."""
+        t0 = time.perf_counter()
         rec = DecisionRecord(
             variant=va.full_name,
             namespace=va.namespace,
             name=va.name,
             model=va.spec.model_id,
         )
-        report.decisions.append(rec)
-        slo = self._find_slo(classes, va)
+        c = _Collected(rec=rec)
+        try:
+            self._collect_variant_inner(c, va, engine, prom, fleet, slo, accelerators)
+        except Exception as e:  # noqa: BLE001 — per-variant isolation
+            c.ok = False
+            rec.detail = f"collect: {e}"
+            c.errors.append(f"{va.full_name}: collect: {e}")
+        c.elapsed_s = time.perf_counter() - t0
+        return c
+
+    def _collect_variant_inner(
+        self,
+        c: _Collected,
+        va: VariantAutoscaling,
+        engine: EngineMetrics,
+        prom: PromClient,
+        fleet: FleetSamples | None,
+        slo: tuple[str, ModelTarget] | None,
+        accelerators: dict[str, AcceleratorSpec],
+    ) -> None:
+        rec = c.rec
         if slo is None:
             rec.detail = f"no SLO entry for model {va.spec.model_id}"
-            report.errors.append(f"{va.full_name}: no SLO entry for model {va.spec.model_id}")
-            return False
+            c.errors.append(f"{va.full_name}: no SLO entry for model {va.spec.model_id}")
+            return
         class_name, target = slo
+        c.class_name, c.target = class_name, target
         rec.slo_ttft_ms = target.slo_ttft
         rec.slo_itl_ms = target.slo_itl
-
-        # Perf data registers under a per-variant model key: the registry is
-        # keyed (model, acc) with last-wins semantics, so two variants
-        # sharing a modelID would otherwise overwrite each other's
-        # CR-carried profiles. (Bucket selection by observed load is
-        # per-variant only across namespaces: metrics are queried by
-        # (model, namespace), the same granularity as the reference, so
-        # same-namespace variants of one model see a blended series.) The
-        # SLO target is duplicated onto the key; `classes` is rebuilt every
-        # cycle.
-        model_key = f"{va.spec.model_id}@{va.full_name}"
-        for sc in classes:
-            if sc.name == class_name and sc.target_for(model_key) is None:
-                sc.model_targets.append(dataclasses.replace(target, model=model_key))
 
         # per-accelerator perf profiles from the CR
         # (reference AddModelAcceleratorProfileToSystemData: utils.go:185-234);
@@ -542,24 +664,35 @@ class Reconciler:
         matching_profiles = [p for p in va.spec.accelerators if p.acc in accelerators]
         if not matching_profiles:
             rec.detail = "no profile matches a known slice shape"
-            report.errors.append(f"{va.full_name}: no profile matches a known slice shape")
-            return False
+            c.errors.append(f"{va.full_name}: no profile matches a known slice shape")
+            return
+        c.matching_profiles = matching_profiles
 
         try:
             wl = get_workload(self.kube, va.namespace, va.name)
         except KubeError as e:
             rec.detail = f"workload: {e}"
-            report.errors.append(f"{va.full_name}: workload: {e}")
-            return False
+            c.errors.append(f"{va.full_name}: workload: {e}")
+            return
+        c.workload = wl
         self._set_owner_reference(va, wl)
 
-        scrape_t0 = time.perf_counter()
-        try:
-            validation = validate_metrics_availability(
-                self.prom, engine, va.spec.model_id, va.namespace
-            )
-        finally:
-            self.instruments.observe_scrape(time.perf_counter() - scrape_t0)
+        # metrics validation: the coalesced fleet probe answers with zero
+        # additional queries; a variant absent from the grouped response
+        # falls back to the per-variant path (which keeps the
+        # namespace-less emulator fallback and exact messages)
+        validation = None
+        if fleet is not None:
+            validation = validate_from_fleet(fleet, va.spec.model_id, va.namespace)
+        if validation is None:
+            scrape_t0 = time.perf_counter()
+            try:
+                validation = validate_metrics_availability(
+                    prom, engine, va.spec.model_id, va.namespace
+                )
+            finally:
+                self.instruments.observe_scrape(time.perf_counter() - scrape_t0)
+        c.validation = validation
         # Scaled-to-zero is ASLEEP, not broken (the metric-series
         # stranding hazard): at 0 replicas every engine series died with
         # the pods, so MetricsMissing is the EXPECTED state — skipping
@@ -587,6 +720,7 @@ class Reconciler:
             ),
         )
         rec.asleep = asleep
+        c.asleep = asleep
         if not validation.available and not asleep:
             rec.detail = f"metrics unavailable ({validation.reason}); variant skipped"
             va.status.set_condition(
@@ -600,7 +734,7 @@ class Reconciler:
                     self.kube.update_variant_autoscaling_status(va)
                 except KubeError:
                     pass
-            return False
+            return
 
         acc_name = va.labels.get("inference.optimization/acceleratorName", "")
         # per-REPLICA price, matching the desired-side formula (core/
@@ -612,18 +746,26 @@ class Reconciler:
         prof = next((p for p in va.spec.accelerators if p.acc == acc_name), None)
         if prof is not None:
             cost *= prof.acc_count * (prof.disagg.slices_per_unit if prof.disagg else 1)
-        scrape_t0 = time.perf_counter()
-        try:
-            if asleep:
-                current = collect_sleeping_alloc(self.prom, engine, va, wl)
-            else:
-                current = collect_current_alloc(self.prom, engine, va, wl, cost)
-        except PromError as e:
-            rec.detail = f"collect: {e}"
-            report.errors.append(f"{va.full_name}: collect: {e}")
-            return False
-        finally:
-            self.instruments.observe_scrape(time.perf_counter() - scrape_t0)
+        # load collection: the coalesced tables answer loaded variants
+        # with zero additional queries; asleep variants keep the
+        # per-variant gateway path (their demand signal lives upstream
+        # of the engine series the fleet queries cover)
+        current = None
+        if fleet is not None and not asleep:
+            current = collect_alloc_from_fleet(fleet, va, wl, cost)
+        if current is None:
+            scrape_t0 = time.perf_counter()
+            try:
+                if asleep:
+                    current = collect_sleeping_alloc(prom, engine, va, wl)
+                else:
+                    current = collect_current_alloc(prom, engine, va, wl, cost)
+            except PromError as e:
+                rec.detail = f"collect: {e}"
+                c.errors.append(f"{va.full_name}: collect: {e}")
+                return
+            finally:
+                self.instruments.observe_scrape(time.perf_counter() - scrape_t0)
         va.status.current_alloc = current
         rec.arrival_rpm = current.load.arrival_rate
         rec.ttft_observed_ms = current.ttft_average
@@ -631,6 +773,46 @@ class Reconciler:
         rec.prev_accelerator = current.accelerator
         rec.prev_replicas = current.num_replicas
         rec.prev_cost = current.variant_cost
+        c.current = current
+        c.ok = True
+
+    def _assemble_variant(
+        self,
+        c: _Collected,
+        va: VariantAutoscaling,
+        classes: list[ServiceClassSpec],
+        spec: SystemSpec,
+        report: CycleReport,
+    ) -> bool:
+        """The serial half of variant preparation: every shared-state
+        mutation (classes/spec appends, forecaster/corrector state, the
+        report's records and errors) in variant-list order, so the solver
+        input and CycleReport are deterministic no matter how the collect
+        pool interleaved. Returns True if the VA was added as a server."""
+        report.decisions.append(c.rec)
+        report.errors.extend(c.errors)
+        if not c.ok:
+            return False
+        rec = c.rec
+        current = c.current
+        validation = c.validation
+        asleep = c.asleep
+        class_name, target = c.class_name, c.target
+        matching_profiles = c.matching_profiles
+
+        # Perf data registers under a per-variant model key: the registry is
+        # keyed (model, acc) with last-wins semantics, so two variants
+        # sharing a modelID would otherwise overwrite each other's
+        # CR-carried profiles. (Bucket selection by observed load is
+        # per-variant only across namespaces: metrics are queried by
+        # (model, namespace), the same granularity as the reference, so
+        # same-namespace variants of one model see a blended series.) The
+        # SLO target is duplicated onto the key; `classes` is rebuilt every
+        # cycle.
+        model_key = f"{va.spec.model_id}@{va.full_name}"
+        for sc in classes:
+            if sc.name == class_name and sc.target_for(model_key) is None:
+                sc.model_targets.append(dataclasses.replace(target, model=model_key))
 
         # predictive scaling: feed this cycle's observed λ into the
         # forecaster and size scale-UP against max(observed, forecast
@@ -790,6 +972,18 @@ class Reconciler:
         return report
 
     def _cycle(self, tracer: Tracer, report: CycleReport) -> None:
+        # one counting view per cycle (wraps whatever self.prom is NOW,
+        # so tests that swap the client mid-flight still count)
+        prom = _CountingProm(self.prom)
+        try:
+            self._cycle_inner(tracer, report, prom)
+        finally:
+            report.prom_queries = prom.count
+            self.instruments.count_prom_queries(prom.count)
+
+    def _cycle_inner(
+        self, tracer: Tracer, report: CycleReport, prom: _CountingProm
+    ) -> None:
         with tracer.span("collect") as sp:
             engine = engine_for(self.config.engine)
             try:
@@ -833,6 +1027,36 @@ class Reconciler:
                 self.forecaster.prune({va.full_name for va in vas})
             if self.stabilizer is not None:
                 self.stabilizer.prune({va.full_name for va in vas})
+            if self.sizing_cache is not None:
+                self.sizing_cache.prune({va.full_name for va in vas})
+
+            # coalesced Prometheus collection: ~Q grouped queries cover
+            # the whole fleet; per-variant fallback handles the rest. A
+            # grouped failure (None) degrades to the per-variant path.
+            fleet: FleetSamples | None = None
+            if self.config.grouped_collection and vas:
+                scrape_t0 = time.perf_counter()
+                fleet = collect_fleet_samples(
+                    prom, engine,
+                    {(va.spec.model_id, va.namespace) for va in vas},
+                )
+                self.instruments.observe_scrape(time.perf_counter() - scrape_t0)
+                if fleet is None:
+                    # not silent: an operator watching
+                    # inferno_cycle_prom_queries_total spike to Q x V
+                    # deserves the reason in the log stream
+                    self.log.warning(
+                        "grouped collection failed; degrading to "
+                        "per-variant queries this cycle"
+                    )
+                sp.set(
+                    grouped_queries=fleet.queries_issued if fleet else 0,
+                    grouped_variants=(
+                        sum(1 for va in vas
+                            if fleet.has(va.spec.model_id, va.namespace))
+                        if fleet else 0
+                    ),
+                )
         if not vas:
             return
 
@@ -844,13 +1068,58 @@ class Reconciler:
         )
         prepared: list[VariantAutoscaling] = []
         with tracer.span("analyze") as sp:
-            for va in vas:
+            # SLO lookup up front on the reconcile thread: _find_slo reads
+            # `classes`, which assembly mutates per variant — workers must
+            # never race that (and the fallback warnings stay ordered)
+            slos = {va.full_name: self._find_slo(classes, va) for va in vas}
+            workers = min(self.config.reconcile_concurrency, max(len(vas), 1))
+            self.instruments.observe_collect_concurrency(workers)
+            sp.set(collect_concurrency=workers)
+            collected: list[_Collected] | None = None
+            if workers > 1:
+                # bounded-concurrency collect on the PERSISTENT pool:
+                # submit in variant order, harvest in variant order. A
+                # failed future degrades to that variant's error path,
+                # never the cycle's.
+                pool = self._executor()
+                futures = [
+                    pool.submit(
+                        self._collect_variant, va, engine, prom, fleet,
+                        slos[va.full_name], accelerators,
+                    )
+                    for va in vas
+                ]
+                collected = []
+                for va, fut in zip(vas, futures):
+                    try:
+                        collected.append(fut.result())
+                    except Exception as e:  # noqa: BLE001 — isolation
+                        rec = DecisionRecord(
+                            variant=va.full_name, namespace=va.namespace,
+                            name=va.name, model=va.spec.model_id,
+                            detail=f"collect: {e}",
+                        )
+                        collected.append(_Collected(
+                            rec=rec, ok=False,
+                            errors=[f"{va.full_name}: collect: {e}"],
+                        ))
+            for i, va in enumerate(vas):
                 t0 = time.perf_counter()
                 with tracer.span("variant", variant=va.full_name) as vsp:
-                    ok = self.prepare(va, engine, classes, accelerators, spec, report)
+                    if collected is None:
+                        c = self._collect_variant(
+                            va, engine, prom, fleet,
+                            slos[va.full_name], accelerators,
+                        )
+                    else:
+                        c = collected[i]
+                        vsp.set(collect_ms=round(c.elapsed_s * 1000.0, 3))
+                    ok = self._assemble_variant(c, va, classes, spec, report)
                     vsp.set(prepared=ok)
+                assemble_s = time.perf_counter() - t0
                 self.instruments.observe_analysis(
-                    va.namespace, va.name, time.perf_counter() - t0
+                    va.namespace, va.name,
+                    assemble_s + (c.elapsed_s if collected is not None else 0.0),
                 )
                 if ok:
                     prepared.append(va)
@@ -863,12 +1132,28 @@ class Reconciler:
         with tracer.span("solve", backend=self.config.compute_backend) as sp:
             t0 = time.perf_counter()
             try:
-                if self.config.compute_backend in ("tpu", "tpu-pallas", "native"):
-                    from inferno_tpu.parallel import calculate_fleet
+                cached_names, signatures = self._replay_sizing_cache(system)
+                to_size = (
+                    None  # size everything (cache off)
+                    if self.sizing_cache is None
+                    else {n for n in system.servers if n not in cached_names}
+                )
+                if to_size is None or to_size:
+                    if self.config.compute_backend in ("tpu", "tpu-pallas", "native"):
+                        from inferno_tpu.parallel import calculate_fleet
 
-                    calculate_fleet(system, backend=self.config.compute_backend)
+                        calculate_fleet(
+                            system, backend=self.config.compute_backend,
+                            only=to_size,
+                        )
+                    else:
+                        system.calculate_all(only=to_size)
                 else:
-                    system.calculate_all()
+                    # every variant replayed: nothing to pack or solve
+                    system.candidates_calculated = True
+                self._store_sizing_cache(
+                    system, to_size, cached_names, signatures, report
+                )
                 report.analysis_ms = (time.perf_counter() - t0) * 1000.0
                 result = Optimizer(optimizer_spec).optimize(system, calculate=False)
                 report.solver_ms = result.solution_time_msec
@@ -903,6 +1188,66 @@ class Reconciler:
         with tracer.span("actuate") as sp:
             self._apply(prepared, solution, report, system)
             sp.set(variants_applied=report.variants_applied)
+
+    # -- sizing cache (controller/sizing_cache.py) ---------------------------
+
+    def _replay_sizing_cache(
+        self, system: System
+    ) -> tuple[set[str], dict[str, tuple | None]]:
+        """Populate all_allocations from the cache for every server whose
+        input signature is unchanged; returns the replayed names and the
+        per-server signatures (for the post-solve store)."""
+        if self.sizing_cache is None:
+            return set(), {}
+        from inferno_tpu.controller.sizing_cache import (
+            server_signature,
+            system_fingerprint,
+        )
+
+        self.sizing_cache.reset_cycle_counts()
+        global_fp = system_fingerprint(system)
+        signatures: dict[str, tuple | None] = {}
+        cached: set[str] = set()
+        for name, server in system.servers.items():
+            sig = server_signature(server, system, global_fp)
+            signatures[name] = sig
+            if sig is None:
+                continue
+            lam = server.load.arrival_rate if server.load is not None else 0.0
+            allocs = self.sizing_cache.lookup(name, sig, lam, server.cur_allocation)
+            if allocs is not None:
+                server.all_allocations = allocs
+                cached.add(name)
+        return cached, signatures
+
+    def _store_sizing_cache(
+        self,
+        system: System,
+        to_size: set[str] | None,
+        cached_names: set[str],
+        signatures: dict[str, tuple | None],
+        report: CycleReport,
+    ) -> None:
+        """Store freshly solved candidates, publish hit/miss telemetry,
+        and stamp `cached` sizing provenance onto the replayed variants'
+        DecisionRecords."""
+        if self.sizing_cache is None:
+            return
+        for name in (to_size or ()):
+            server = system.servers.get(name)
+            sig = signatures.get(name)
+            if server is None or sig is None:
+                continue
+            lam = server.load.arrival_rate if server.load is not None else 0.0
+            self.sizing_cache.store(name, sig, lam, server.all_allocations)
+        report.sizing_cache_hits = self.sizing_cache.hits
+        report.sizing_cache_misses = self.sizing_cache.misses
+        self.instruments.set_cache_outcome(
+            self.sizing_cache.hits, self.sizing_cache.misses
+        )
+        for rec in report.decisions:
+            if rec.variant in cached_names:
+                rec.sizing_provenance = SIZING_PROVENANCE_CACHED
 
     def _finish_cycle(self, tracer: Tracer, report: CycleReport) -> None:
         """Seal the cycle's observability outputs: trace, histogram,
@@ -944,11 +1289,48 @@ class Reconciler:
     ) -> None:
         """(reference applyOptimizedAllocations: controller.go:338-407)
         Also completes each prepared variant's DecisionRecord: the solved
-        allocation (or its absence) is the decision being explained."""
+        allocation (or its absence) is the decision being explained.
+
+        With RECONCILE_CONCURRENCY > 1 the per-variant refetch + status
+        writes + actuation run on a bounded pool (variants are
+        independent Kube objects); outcomes merge back in variant-list
+        order so report.errors and applied counts stay deterministic. A
+        failed future is that variant's error path, never the cycle's.
+        """
         now = _utcnow()
         recs = {r.variant: r for r in report.decisions}
+        workers = min(self.config.reconcile_concurrency, max(len(prepared), 1))
+        if workers > 1:
+            pool = self._executor()
+            futures = [
+                pool.submit(
+                    self._apply_one, va, recs.get(va.full_name),
+                    solution.get(va.full_name), now, system,
+                )
+                for va in prepared
+            ]
+            gate_lost = False
+            for va, fut in zip(prepared, futures):
+                try:
+                    errors, applied, lost = fut.result()
+                except Exception as e:  # noqa: BLE001 — isolation
+                    errors, applied, lost = (
+                        [f"{va.full_name}: apply: {e}"], False, False,
+                    )
+                    rec = recs.get(va.full_name)
+                    if rec is not None:
+                        rec.decide(REASON_ERROR, detail=f"apply: {e}")
+                report.errors.extend(errors)
+                if applied:
+                    report.variants_applied += 1
+                gate_lost = gate_lost or lost
+            if gate_lost:
+                report.errors.append(
+                    "leadership lost mid-cycle; aborting actuation and "
+                    "status writes"
+                )
+            return
         for i, va in enumerate(prepared):
-            rec = recs.get(va.full_name)
             if not self.gate():
                 report.errors.append(
                     "leadership lost mid-cycle; aborting actuation and status writes"
@@ -961,103 +1343,129 @@ class Reconciler:
                     if lrec is not None:
                         lrec.detail = "leadership lost mid-cycle; decision not actuated"
                 return
-            try:
-                fresh = self.kube.get_variant_autoscaling(va.namespace, va.name)
-            except KubeError as e:
-                report.errors.append(f"{va.full_name}: refetch: {e}")
-                if rec is not None:
-                    rec.decide(REASON_ERROR, detail=f"refetch: {e}")
-                continue
-            fresh.status = va.status
-            alloc = solution.get(va.full_name)
-            if alloc is not None:
-                # scale-down stabilization (forecast/stabilizer.py): act
-                # on the PEAK recommendation within the trailing window —
-                # upscales pass through, downscales wait until every
-                # higher recommendation has aged out (HPA scaleDown
-                # stabilization semantics). Gated here, at the single
-                # point the solver's answer becomes the actuated desired,
-                # so the direct-scale path, the emitted gauges, and the
-                # CR status all see the same stabilized count.
-                desired = alloc.num_replicas
-                held = False
-                if self.stabilizer is not None:
-                    # keyed by variant AND slice shape: replica counts
-                    # are not comparable across a shape migration
-                    # (keep_accelerator=false) — 3x v5e-16 after 8x
-                    # v5e-8 is a shape change, not a scale-down to gate.
-                    # A migration therefore starts a fresh window; stale
-                    # shape keys are pruned with the variant.
-                    desired, held = self.stabilizer.recommend(
-                        f"{va.full_name}@{alloc.accelerator}",
-                        alloc.num_replicas,
-                        self.clock(),
-                    )
-                fresh.status.desired_optimized_alloc.accelerator = alloc.accelerator
-                fresh.status.desired_optimized_alloc.num_replicas = desired
-                fresh.status.desired_optimized_alloc.last_run_time = now
-                fresh.status.set_condition(
-                    TYPE_OPTIMIZATION_READY,
-                    "True",
-                    REASON_OPTIMIZATION_SUCCEEDED,
-                    "optimization completed",
-                )
-                if rec is not None:
-                    self._explain_decision(rec, va.full_name, alloc, system)
-                    if held:
-                        rec.decide(
-                            REASON_STABILIZATION_HOLD,
-                            accelerator=alloc.accelerator,
-                            replicas=desired,
-                            detail=(
-                                f"scale-down gated: solver recommended "
-                                f"{alloc.num_replicas} but the peak within the "
-                                f"{self.config.scale_down_stabilization_s:.0f}s "
-                                f"stabilization window is {desired}"
-                            ),
-                        )
-            else:
-                # squeezed out (capacity exhausted / SLO unachievable): the
-                # decision this cycle is the minimum — leaving the stale
-                # desired from an earlier cycle standing would keep the
-                # variant scaled out on chips the solver just reassigned to
-                # higher-priority classes. Floor at 1 unless scale-to-zero
-                # is enabled: scaling to 0 kills the engine's metric
-                # series, which would keep the variant out of the solver
-                # (metrics unavailable) even after capacity frees — a
-                # stranding loop.
-                # exactly the minimum, not min(stale, floor): a fresh VA's
-                # stale desired is 0, and clamping against it would scale a
-                # never-optimized variant to zero with scale-to-zero off
-                floor = 0 if self.config.scale_to_zero else 1
-                fresh.status.desired_optimized_alloc.num_replicas = floor
-                fresh.status.desired_optimized_alloc.last_run_time = now
-                fresh.status.set_condition(
-                    TYPE_OPTIMIZATION_READY,
-                    "False",
-                    REASON_OPTIMIZATION_FAILED,
-                    "no feasible allocation (SLO unachievable or capacity exhausted)",
-                )
-                if rec is not None:
-                    rec.decide(
-                        REASON_CAPACITY_LIMITED,
-                        replicas=floor,
-                        detail="no feasible allocation "
-                               "(SLO unachievable or capacity exhausted)",
-                    )
-            try:
-                self.actuator.emit_metrics(fresh)
-                fresh.status.actuation_applied = True
-            except KubeError as e:
-                # metric emission failure must not fail the cycle
-                # (reference: actuator.go:69-74)
-                report.errors.append(f"{va.full_name}: actuate: {e}")
-                fresh.status.actuation_applied = False
-            try:
-                self.kube.update_variant_autoscaling_status(fresh)
+            errors, applied, _ = self._apply_one(
+                va, recs.get(va.full_name), solution.get(va.full_name), now, system
+            )
+            report.errors.extend(errors)
+            if applied:
                 report.variants_applied += 1
-            except KubeError as e:
-                report.errors.append(f"{va.full_name}: status: {e}")
+
+    def _apply_one(
+        self,
+        va: VariantAutoscaling,
+        rec: DecisionRecord | None,
+        alloc,
+        now: str,
+        system: System | None,
+    ) -> tuple[list[str], bool, bool]:
+        """Apply one variant's decision: refetch, stabilize, write status
+        and conditions, emit actuation metrics. Returns (errors, applied,
+        gate_lost); safe to run on a pool worker — touches only this
+        variant's objects plus the thread-safe emitter/stabilizer."""
+        errors: list[str] = []
+        if not self.gate():
+            # deposed mid-cycle: the new leader owns this write
+            if rec is not None:
+                rec.detail = "leadership lost mid-cycle; decision not actuated"
+            return errors, False, True
+        try:
+            fresh = self.kube.get_variant_autoscaling(va.namespace, va.name)
+        except KubeError as e:
+            errors.append(f"{va.full_name}: refetch: {e}")
+            if rec is not None:
+                rec.decide(REASON_ERROR, detail=f"refetch: {e}")
+            return errors, False, False
+        fresh.status = va.status
+        if alloc is not None:
+            # scale-down stabilization (forecast/stabilizer.py): act
+            # on the PEAK recommendation within the trailing window —
+            # upscales pass through, downscales wait until every
+            # higher recommendation has aged out (HPA scaleDown
+            # stabilization semantics). Gated here, at the single
+            # point the solver's answer becomes the actuated desired,
+            # so the direct-scale path, the emitted gauges, and the
+            # CR status all see the same stabilized count.
+            desired = alloc.num_replicas
+            held = False
+            if self.stabilizer is not None:
+                # keyed by variant AND slice shape: replica counts
+                # are not comparable across a shape migration
+                # (keep_accelerator=false) — 3x v5e-16 after 8x
+                # v5e-8 is a shape change, not a scale-down to gate.
+                # A migration therefore starts a fresh window; stale
+                # shape keys are pruned with the variant.
+                desired, held = self.stabilizer.recommend(
+                    f"{va.full_name}@{alloc.accelerator}",
+                    alloc.num_replicas,
+                    self.clock(),
+                )
+            fresh.status.desired_optimized_alloc.accelerator = alloc.accelerator
+            fresh.status.desired_optimized_alloc.num_replicas = desired
+            fresh.status.desired_optimized_alloc.last_run_time = now
+            fresh.status.set_condition(
+                TYPE_OPTIMIZATION_READY,
+                "True",
+                REASON_OPTIMIZATION_SUCCEEDED,
+                "optimization completed",
+            )
+            if rec is not None:
+                self._explain_decision(rec, va.full_name, alloc, system)
+                if held:
+                    rec.decide(
+                        REASON_STABILIZATION_HOLD,
+                        accelerator=alloc.accelerator,
+                        replicas=desired,
+                        detail=(
+                            f"scale-down gated: solver recommended "
+                            f"{alloc.num_replicas} but the peak within the "
+                            f"{self.config.scale_down_stabilization_s:.0f}s "
+                            f"stabilization window is {desired}"
+                        ),
+                    )
+        else:
+            # squeezed out (capacity exhausted / SLO unachievable): the
+            # decision this cycle is the minimum — leaving the stale
+            # desired from an earlier cycle standing would keep the
+            # variant scaled out on chips the solver just reassigned to
+            # higher-priority classes. Floor at 1 unless scale-to-zero
+            # is enabled: scaling to 0 kills the engine's metric
+            # series, which would keep the variant out of the solver
+            # (metrics unavailable) even after capacity frees — a
+            # stranding loop.
+            # exactly the minimum, not min(stale, floor): a fresh VA's
+            # stale desired is 0, and clamping against it would scale a
+            # never-optimized variant to zero with scale-to-zero off
+            floor = 0 if self.config.scale_to_zero else 1
+            fresh.status.desired_optimized_alloc.num_replicas = floor
+            fresh.status.desired_optimized_alloc.last_run_time = now
+            fresh.status.set_condition(
+                TYPE_OPTIMIZATION_READY,
+                "False",
+                REASON_OPTIMIZATION_FAILED,
+                "no feasible allocation (SLO unachievable or capacity exhausted)",
+            )
+            if rec is not None:
+                rec.decide(
+                    REASON_CAPACITY_LIMITED,
+                    replicas=floor,
+                    detail="no feasible allocation "
+                           "(SLO unachievable or capacity exhausted)",
+                )
+        try:
+            self.actuator.emit_metrics(fresh)
+            fresh.status.actuation_applied = True
+        except KubeError as e:
+            # metric emission failure must not fail the cycle
+            # (reference: actuator.go:69-74)
+            errors.append(f"{va.full_name}: actuate: {e}")
+            fresh.status.actuation_applied = False
+        applied = False
+        try:
+            self.kube.update_variant_autoscaling_status(fresh)
+            applied = True
+        except KubeError as e:
+            errors.append(f"{va.full_name}: status: {e}")
+        return errors, applied, False
 
     def _explain_decision(
         self, rec: DecisionRecord, server_name: str, alloc, system: System | None

@@ -236,9 +236,16 @@ class System:
 
     # -- solve support ------------------------------------------------------
 
-    def calculate_all(self) -> None:
-        """Candidate allocations for every server (the analyzer hot loop)."""
-        for server in self.servers.values():
+    def calculate_all(self, only: set[str] | None = None) -> None:
+        """Candidate allocations for every server (the analyzer hot loop).
+
+        `only` restricts sizing to a server subset — the reconciler's
+        input-signature cache replays the rest from the previous cycle
+        (controller/sizing_cache.py); servers outside the subset keep
+        whatever all_allocations they already carry."""
+        for name, server in self.servers.items():
+            if only is not None and name not in only:
+                continue
             server.calculate(self)
         self.candidates_calculated = True
 

@@ -220,6 +220,19 @@ class EmulatedEngine:
                 self.waiting.popleft()
                 if was_idle:
                     nxt.arrived_emu = max(nxt.arrived_emu, self.emu_ms)
+                    # The arrival stamp may sit AHEAD of the lazily-ticked
+                    # idle clock: submit() extrapolates from the last tick,
+                    # and a descheduled idle loop leaves emu_ms behind wall
+                    # time by whole scheduling quanta. Discrete-event
+                    # semantics: an idle engine begins service AT the
+                    # arrival instant — jump the clock forward so the
+                    # first-token/finish stamps accumulate real step time
+                    # instead of collapsing into their max() clamps (the
+                    # intermittent "decode phase reads 0 emulated ms"
+                    # flake on loaded hosts).
+                    if nxt.arrived_emu > self.emu_ms:
+                        self.emu_ms = nxt.arrived_emu
+                        self._last_tick_wall = time.time()
                 nxt.admit_step = self._step_index
                 self.running[id(nxt)] = nxt
                 self._new.append(nxt)

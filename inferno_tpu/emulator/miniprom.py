@@ -38,7 +38,62 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 _LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([^\s]+)")
-_MATCHER = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+_MATCHER = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)(=~|=)"([^"]*)"')
+
+
+class _Regex:
+    """A compiled `=~` matcher value (Prometheus regexes are anchored)."""
+
+    def __init__(self, pattern: str):
+        self.pattern = pattern
+        self._re = re.compile(pattern)
+
+    def matches(self, value: str) -> bool:
+        return self._re.fullmatch(value) is not None
+
+
+def _unquote(value: str) -> str:
+    """Undo string-literal escaping (the shared subset of PromQL/Go and
+    exposition-format rules): `\\\\` -> `\\`, `\\"` -> `"`, `\\n` ->
+    newline. The collector's grouped selectors double their regex
+    backslashes for the string layer (collector._promql_quote) — a
+    matcher value must be unescaped HERE, like real Prometheus does,
+    before it is compiled as a regex."""
+    if "\\" not in value:
+        return value
+    out = []
+    i = 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append({"n": "\n", "t": "\t"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _matcher_dict(raw: str) -> dict:
+    """`a="x",b=~"y|z"` -> {a: "x", b: _Regex}; exposition label parsing
+    keeps using plain equality (series never carry regex values)."""
+    out: dict = {}
+    for name, op, value in _MATCHER.findall(raw):
+        value = _unquote(value)
+        out[name] = _Regex(value) if op == "=~" else value
+    return out
+
+
+def _label_match(labels: dict, matchers: dict) -> bool:
+    for k, v in matchers.items():
+        got = labels.get(k, "")
+        if isinstance(v, _Regex):
+            if not v.matches(got):
+                return False
+        elif got != v:
+            return False
+    return True
 
 
 def parse_exposition(text: str):
@@ -55,21 +110,35 @@ def parse_exposition(text: str):
             value = float(raw_val)
         except ValueError:
             continue
-        labels = dict(_MATCHER.findall(raw_labels)) if raw_labels else {}
+        labels = (
+            {n: _unquote(v) for n, _op, v in _MATCHER.findall(raw_labels)}
+            if raw_labels else {}
+        )
         out.append((name, labels, value))
     return out
 
 
 def _parse_vector_selector(expr: str):
-    """`name{a="b",...}` -> (name, {a: b}); bare `name` -> (name, {})."""
+    """`name{a="b",c=~"d|e",...}` -> (name, matcher dict); bare `name` ->
+    (name, {}). Matcher values are plain strings for `=` and _Regex for
+    `=~` (the coalesced collector's fleet selectors)."""
     brace = expr.find("{")
     if brace < 0:
         return expr.strip(), {}
-    return expr[:brace].strip(), dict(_MATCHER.findall(expr[brace:]))
+    return expr[:brace].strip(), _matcher_dict(expr[brace:])
 
 
 _RATE = re.compile(r"sum\(rate\(([^\[]+)\[[^\]]*\]\)\)")
 _MAX_BY = re.compile(r"^max\(([^)]+)\)\s*by\s*\(([^)]*)\)$")
+# coalesced collector shapes (inferno_tpu.controller.collector
+# .grouped_queries): one query per metric over the whole fleet, split
+# back out per variant with a by() clause
+_SUM_BY = re.compile(r"^sum\(([^)]+)\)\s*by\s*\(([^)]*)\)$")
+_RATE_BY = re.compile(r"^sum\(rate\(([^\[]+)\[[^\]]*\]\)\)\s*by\s*\(([^)]*)\)$")
+_RATIO_BY = re.compile(
+    r"^sum\(rate\(([^\[]+)\[[^\]]*\]\)\)\s*by\s*\(([^)]*)\)"
+    r"/sum\(rate\(([^\[]+)\[[^\]]*\]\)\)\s*by\s*\(([^)]*)\)$"
+)
 
 
 class MiniProm:
@@ -99,19 +168,33 @@ class MiniProm:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802
-                parsed = urllib.parse.urlparse(self.path)
-                if parsed.path != "/api/v1/query":
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                query = urllib.parse.parse_qs(parsed.query).get("query", [""])[0]
+            def _answer(self, raw_qs: str) -> None:
+                query = urllib.parse.parse_qs(raw_qs).get("query", [""])[0]
                 body = json.dumps(outer.evaluate(query)).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path != "/api/v1/query":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self._answer(parsed.query)
+
+            def do_POST(self):  # noqa: N802
+                # form-encoded /api/v1/query — the client switches to
+                # POST when a coalesced fleet selector outgrows the GET
+                # request line (promclient._POST_THRESHOLD)
+                if urllib.parse.urlparse(self.path).path != "/api/v1/query":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                self._answer(self.rfile.read(length).decode())
 
             def log_message(self, *args):  # quiet
                 pass
@@ -202,17 +285,33 @@ class MiniProm:
             if hist and hist[-1][1] is None:
                 continue  # stale: vanished from its target's last scrape
             labels = dict(labels_key)
-            if all(labels.get(k) == v for k, v in matchers.items()):
+            if _label_match(labels, matchers):
                 out.append((labels, [(t, v) for t, v in hist if v is not None]))
         return out
 
-    def _rate(self, name: str, matchers: dict) -> float:
-        """Windowed counter rate summed over matching series: positive
-        deltas within the window / covered time (counter-reset safe)."""
-        cutoff = time.time() - self.window_seconds
+    @staticmethod
+    def _group_by(series, by: tuple[str, ...]) -> dict[tuple, list]:
+        """Series grouped by their values of the by() labels. Series
+        missing one of the labels are excluded — the coalesced collector
+        drops unlabelled samples from grouped responses anyway (they take
+        the per-variant fallback path)."""
+        groups: dict[tuple, list] = {}
+        for labels, hist in series:
+            key = tuple(labels.get(k) for k in by)
+            if any(v is None for v in key):
+                continue
+            groups.setdefault(key, []).append((labels, hist))
+        return groups
+
+    def _rate_of(self, series, cutoff: float) -> float:
+        """Windowed counter rate summed over the given series: positive
+        deltas within the window / covered time (counter-reset safe).
+        The one rate evaluator — grouped queries run it per group over
+        the same per-series accumulation, so coalescing cannot drift
+        from the per-variant result."""
         total = 0.0
         elapsed = 0.0
-        for _, hist in self._matching(name, matchers):
+        for _, hist in series:
             pts = [(t, v) for t, v in hist if t >= cutoff]
             if len(pts) < 2:
                 continue
@@ -224,6 +323,11 @@ class MiniProm:
         if elapsed <= 0:
             return 0.0
         return total / elapsed
+
+    def _rate(self, name: str, matchers: dict) -> float:
+        return self._rate_of(
+            self._matching(name, matchers), time.time() - self.window_seconds
+        )
 
     def evaluate(self, query: str) -> dict:
         query = query.strip()
@@ -268,6 +372,55 @@ class MiniProm:
                  for k, v in sorted(grouped.items())]
             )
 
+        # coalesced fleet shapes (grouped by variant-identifying labels),
+        # checked BEFORE the generic rate forms their bodies also match
+        def by_labels(raw: str) -> tuple[str, ...]:
+            return tuple(k.strip() for k in raw.split(",") if k.strip())
+
+        def group_vector(values: dict[tuple, float], by: tuple[str, ...]):
+            now = time.time()
+            return vector(
+                [{"metric": dict(zip(by, key)), "value": [now, str(v)]}
+                 for key, v in sorted(values.items())]
+            )
+
+        m = _RATIO_BY.match(query)
+        if m:
+            num_sel, by_raw, den_sel, _ = m.groups()
+            by = by_labels(by_raw)
+            cutoff = time.time() - self.window_seconds
+            num_name, num_matchers = _parse_vector_selector(num_sel)
+            den_name, den_matchers = _parse_vector_selector(den_sel)
+            num_groups = self._group_by(self._matching(num_name, num_matchers), by)
+            den_groups = self._group_by(self._matching(den_name, den_matchers), by)
+            out: dict[tuple, float] = {}
+            for key in set(num_groups) | set(den_groups):
+                den = self._rate_of(den_groups.get(key, []), cutoff)
+                num = self._rate_of(num_groups.get(key, []), cutoff)
+                out[key] = num / den if den > 0 else 0.0
+            return group_vector(out, by)
+
+        m = _RATE_BY.match(query)
+        if m:
+            name, matchers = _parse_vector_selector(m.group(1))
+            by = by_labels(m.group(2))
+            cutoff = time.time() - self.window_seconds
+            groups = self._group_by(self._matching(name, matchers), by)
+            return group_vector(
+                {k: self._rate_of(s, cutoff) for k, s in groups.items()}, by
+            )
+
+        m = _SUM_BY.match(query)
+        if m and not m.group(1).startswith("rate("):
+            name, matchers = _parse_vector_selector(m.group(1))
+            by = by_labels(m.group(2))
+            groups = self._group_by(self._matching(name, matchers), by)
+            return group_vector(
+                {k: sum(hist[-1][1] for _, hist in s if hist)
+                 for k, s in groups.items()},
+                by,
+            )
+
         rates = _RATE.findall(query)
         if rates:
             selectors = [_parse_vector_selector(r) for r in rates]
@@ -280,7 +433,9 @@ class MiniProm:
             if not self._matching(name, matchers):
                 return vector([])
             return vector(
-                [{"metric": dict(matchers), "value": [time.time(), str(value)]}]
+                [{"metric": {k: v for k, v in matchers.items()
+                             if isinstance(v, str)},
+                  "value": [time.time(), str(value)]}]
             )
 
         # instant vector selector

@@ -19,6 +19,8 @@ from inferno_tpu.obs.decision import (
     REASON_FORECAST_BOUND,
     REASON_SLO_BOUND,
     REASON_STABILIZATION_HOLD,
+    SIZING_PROVENANCE_CACHED,
+    SIZING_PROVENANCE_SOLVED,
     DecisionRecord,
 )
 from inferno_tpu.obs.trace import Span, TraceBuffer, Tracer
@@ -29,6 +31,8 @@ __all__ = [
     "PROVENANCE_CR",
     "RATE_PROVENANCE_FORECAST",
     "RATE_PROVENANCE_OBSERVED",
+    "SIZING_PROVENANCE_CACHED",
+    "SIZING_PROVENANCE_SOLVED",
     "REASON_ASLEEP",
     "REASON_CAPACITY_LIMITED",
     "REASON_CODES",

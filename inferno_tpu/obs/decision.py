@@ -47,6 +47,13 @@ PROVENANCE_CORRECTED = "corrected"  # corrector-calibrated parameters
 RATE_PROVENANCE_OBSERVED = "observed"  # the collector's observed λ
 RATE_PROVENANCE_FORECAST = "forecast"  # the forecast upper band exceeded it
 
+# Sizing-result provenance values: whether this cycle's candidate
+# allocations were freshly solved or replayed from the input-signature
+# sizing cache (controller/sizing_cache.py) because every sizing input
+# was unchanged within tolerance
+SIZING_PROVENANCE_SOLVED = "solved"
+SIZING_PROVENANCE_CACHED = "cached"
+
 
 @dataclasses.dataclass
 class DecisionRecord:
@@ -82,6 +89,9 @@ class DecisionRecord:
     forecast_burst: bool = False  # burst detector fired this cycle
 
     # -- the decision -------------------------------------------------------
+    # "solved" | "cached" — cached means the candidate allocations were
+    # replayed from the sizing cache (inputs unchanged within tolerance)
+    sizing_provenance: str = SIZING_PROVENANCE_SOLVED
     accelerator: str = ""
     replicas: int = 0
     prev_accelerator: str = ""

@@ -1,0 +1,202 @@
+"""Synthetic N-variant fleet fixtures (tests + the bench.py cycle bench).
+
+Builds the three pieces a fleet-scale reconcile cycle needs without any
+cluster or hardware: an `InMemoryCluster` carrying N VariantAutoscalings
+(distinct model ids, one namespace, one Deployment each), Prometheus
+exposition callables MiniProm can scrape for those variants, and a
+FakeProm that answers the coalesced collector's grouped query shapes
+from a static per-variant table (for bit-exact parity tests where
+MiniProm's walking clock would blur comparisons).
+"""
+
+from __future__ import annotations
+
+import time
+
+from inferno_tpu.controller.crd import (
+    ACCELERATOR_LABEL,
+    AcceleratorProfile,
+    ConfigMapKeyRef,
+    VariantAutoscaling,
+    VariantAutoscalingSpec,
+)
+from inferno_tpu.config.types import DecodeParms, PrefillParms
+from inferno_tpu.controller.engines import EngineMetrics, engine_for
+from inferno_tpu.controller.kube import InMemoryCluster
+
+CONFIG_NS = "inferno-system"
+FLEET_NS = "fleet"
+SERVICE_CLASS = "Premium"
+
+
+def fleet_model(i: int) -> str:
+    return f"bench/model-{i:03d}"
+
+
+def fleet_variant(i: int) -> str:
+    return f"variant-{i:03d}"
+
+
+def fleet_cluster(
+    n_variants: int,
+    namespace: str = FLEET_NS,
+    config_namespace: str = CONFIG_NS,
+    replicas: int = 1,
+    slo_ttft: float = 500.0,
+    slo_itl: float = 24.0,
+) -> InMemoryCluster:
+    """An in-memory cluster with N variants of distinct models, each
+    owning a Deployment, plus the accelerator-cost / service-class /
+    controller ConfigMaps a cycle reads."""
+    cluster = InMemoryCluster()
+    cluster.set_configmap(config_namespace, "accelerator-unit-costs", {
+        "v5e-4": '{"cost": 10.0}',
+        "v5e-16": '{"cost": 10.0}',
+    })
+    entries = "".join(
+        f"  - model: {fleet_model(i)}\n"
+        f"    slo-ttft: {slo_ttft}\n    slo-tpot: {slo_itl}\n"
+        for i in range(n_variants)
+    )
+    cluster.set_configmap(config_namespace, "service-classes-config", {
+        "premium.yaml": f"name: {SERVICE_CLASS}\npriority: 1\ndata:\n{entries}",
+    })
+    cluster.set_configmap(config_namespace, "inferno-autoscaler-config", {})
+    for i in range(n_variants):
+        va = VariantAutoscaling(
+            name=fleet_variant(i),
+            namespace=namespace,
+            labels={ACCELERATOR_LABEL: "v5e-4"},
+            spec=VariantAutoscalingSpec(
+                model_id=fleet_model(i),
+                slo_class_ref=ConfigMapKeyRef(
+                    name="service-classes-config", key=SERVICE_CLASS
+                ),
+                accelerators=[
+                    AcceleratorProfile(
+                        acc="v5e-4", acc_count=1, max_batch_size=64,
+                        at_tokens=128,
+                        decode_parms=DecodeParms(alpha=18.0, beta=0.3),
+                        prefill_parms=PrefillParms(gamma=5.0, delta=0.02),
+                    ),
+                ],
+            ),
+        )
+        cluster.add_variant_autoscaling(va)
+        cluster.add_deployment(namespace, fleet_variant(i), replicas=replicas)
+    return cluster
+
+
+def fleet_targets(
+    n_variants: int,
+    arrival_rps: float = 5.0,
+    in_tokens: float = 128.0,
+    out_tokens: float = 128.0,
+    ttft_s: float = 0.05,
+    itl_s: float = 0.02,
+    running: float = 3.0,
+):
+    """MiniProm scrape targets: one exposition callable per variant whose
+    counters advance with WALL time at the requested rates, so rate()
+    reads arrival_rps regardless of the scrape cadence. Pass to
+    MiniProm([...], ...) with a namespace relabel, e.g.::
+
+        MiniProm([(t, {"namespace": FLEET_NS}) for t in fleet_targets(50)])
+    """
+    t0 = time.time()
+
+    def make(i: int):
+        model = fleet_model(i)
+
+        def render() -> str:
+            count = arrival_rps * (time.time() - t0)
+            sel = f'{{model_name="{model}"}}'
+            return "\n".join([
+                f"vllm:num_requests_running{sel} {running}",
+                f"vllm:request_success_total{sel} {count}",
+                f"vllm:request_prompt_tokens_sum{sel} {in_tokens * count}",
+                f"vllm:request_prompt_tokens_count{sel} {count}",
+                f"vllm:request_generation_tokens_sum{sel} {out_tokens * count}",
+                f"vllm:request_generation_tokens_count{sel} {count}",
+                f"vllm:time_to_first_token_seconds_sum{sel} {ttft_s * count}",
+                f"vllm:time_to_first_token_seconds_count{sel} {count}",
+                f"vllm:time_per_output_token_seconds_sum{sel} {itl_s * count}",
+                f"vllm:time_per_output_token_seconds_count{sel} {count}",
+                f"vllm:num_requests_max{sel} 64",
+            ]) + "\n"
+
+        render.__name__ = f"{model}/0"  # `up` instance label
+        return render
+
+    return [make(i) for i in range(n_variants)]
+
+
+def fleet_fake_prom(
+    rows: dict[tuple[str, str], dict],
+    engine: EngineMetrics | None = None,
+    age_seconds: float = 0.0,
+    grouped: bool = True,
+):
+    """A FakeProm answering BOTH the coalesced grouped shapes and the
+    per-variant single-query shapes from one static table, for bit-exact
+    parity tests (grouped on vs off must produce identical cycles).
+
+    rows: (model, namespace) -> dict with any of running, arrival_rps,
+    in_tokens, out_tokens, ttft_s, itl_s, max_batch. `grouped=False`
+    leaves the grouped queries unanswered (empty vectors), forcing the
+    per-variant fallback — the lever for fallback tests.
+    """
+    from inferno_tpu.controller.collector import grouped_queries
+    from inferno_tpu.controller.promclient import FakeProm, Sample
+
+    engine = engine or engine_for("vllm-tpu")
+    prom = FakeProm()
+    ml = engine.model_label
+
+    def col(field: str, default: float = 0.0):
+        return [
+            ({ml: m, "namespace": ns}, float(vals.get(field, default)))
+            for (m, ns), vals in sorted(rows.items())
+        ]
+
+    if grouped and rows:
+        qs = grouped_queries(engine, set(rows))
+        prom.set_samples(qs["running"], col("running"), age_seconds=age_seconds)
+        prom.set_samples(qs["arrival"], col("arrival_rps"), age_seconds=age_seconds)
+        prom.set_samples(qs["avg_in"], col("in_tokens"), age_seconds=age_seconds)
+        prom.set_samples(qs["avg_out"], col("out_tokens"), age_seconds=age_seconds)
+        prom.set_samples(qs["ttft"], col("ttft_s"), age_seconds=age_seconds)
+        prom.set_samples(qs["itl"], col("itl_s"), age_seconds=age_seconds)
+        if "max_batch" in qs:
+            prom.set_samples(qs["max_batch"], col("max_batch", 64.0),
+                             age_seconds=age_seconds)
+
+    def handler(q: str):
+        # per-variant shapes: find the row whose model id appears in the
+        # query selector (the collector always filters on the model label)
+        for (m, ns), vals in sorted(rows.items()):
+            if f'"{m}"' not in q:
+                continue
+
+            def s(v: float):
+                return [Sample(labels={}, value=float(v),
+                               timestamp=time.time() - age_seconds)]
+
+            if "num_requests_running" in q or "slots_used" in q:
+                return s(vals.get("running", 0.0))
+            if "num_requests_max" in q or "total_slots" in q:
+                return s(vals.get("max_batch", 64.0))
+            if "success" in q:
+                return s(vals.get("arrival_rps", 0.0))
+            if "prompt_tokens" in q or "input_length" in q:
+                return s(vals.get("in_tokens", 0.0))
+            if "generation_tokens" in q or "output_length" in q:
+                return s(vals.get("out_tokens", 0.0))
+            if "first_token" in q:
+                return s(vals.get("ttft_s", 0.0))
+            if "per_output_token" in q:
+                return s(vals.get("itl_s", 0.0))
+        return []
+
+    prom.add_handler(lambda q: True, handler)
+    return prom

