@@ -6,8 +6,8 @@ IMG ?= inferno-tpu-autoscaler:latest
 CLUSTER ?= inferno-tpu
 
 .PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
-        native lint lint-metrics manifests-sync docker-build deploy-kind \
-        deploy undeploy clean
+        bench-sizing native lint lint-metrics manifests-sync docker-build \
+        deploy-kind deploy undeploy clean
 
 all: native test
 
@@ -37,6 +37,11 @@ test-apiserver:
 # Benchmark: one JSON line (fleet sizing cycle vs reference algorithm).
 bench:
 	$(PYTHON) bench.py
+
+# Vectorized-sizing scaling benchmark (ISSUE-6): one jitted solve for
+# 200 -> 10k synthetic variants, curve recorded in bench_full.json
+bench-sizing:
+	$(PYTHON) bench.py --sizing
 
 # Synthetic 200-variant reconcile-cycle benchmark: serial per-variant
 # collection vs coalesced queries + concurrency + sizing cache

@@ -578,6 +578,118 @@ def reconcile_cycle_bench(n_variants: int = 200, repeats: int = 3) -> dict:
     }
 
 
+BENCH_R05_CYCLE_MS = 333.0  # optimized 200-variant reconcile cycle, BENCH_r05
+
+
+def sizing_scaling_bench(
+    sizes: tuple[int, ...] = (200, 1000, 3000, 10000),
+    repeats: int = 4,
+    backend: str | None = None,
+) -> dict:
+    """Whole-fleet vectorized sizing scaling curve (ISSUE-6).
+
+    Times ONE sizing pass — `calculate_fleet` (columnar snapshot packing
+    + the fused jitted solve + lazy writeback) followed by the unlimited
+    solver's vectorized argmin consumption — at growing fleet sizes,
+    with every variant's arrival rate perturbed between repeats so each
+    timed pass is an honest every-variant-changed recompute (an
+    unchanged fleet replays from the O(1) version memo and measures
+    nothing). Fleets come from `testing/fleet.fleet_system_spec` with
+    one profiled shape per variant — the same fleet shape as the
+    BENCH_r05 200-variant reconcile fleet the acceptance bound compares
+    against — plus the periodic tandem / zero-load / pinned /
+    infeasible edge variants. jit warmup per size is OUTSIDE the timer
+    (compiled programs are reused across production cycles).
+
+    The scalar oracle (`System.calculate_all`) is timed at the smallest
+    size only: at 10k variants the per-variant Python loop takes minutes
+    and is exactly what this PR deletes from the cycle. A 2-shape
+    10k-variant stress point (multi-candidate argmin at scale) rides
+    along, reported but outside the acceptance bound."""
+    import jax
+
+    from inferno_tpu.parallel import reset_fleet_state
+    from inferno_tpu.testing.fleet import fleet_system_spec, perturb_loads
+
+    if backend is None:
+        backend = "tpu" if jax.default_backend() == "tpu" else "jax"
+
+    def run_curve(n: int, shapes: int) -> dict:
+        reset_fleet_state()
+        spec = fleet_system_spec(n, shapes_per_variant=shapes)
+        opt = spec.optimizer
+        system = System(spec)
+        calculate_fleet(system, backend=backend)  # jit warmup
+        optimize(system, opt)
+        from inferno_tpu.parallel import build_fleet, build_tandem_fleet
+
+        plan = build_fleet(system)
+        tandem = build_tandem_fleet(system)
+        lanes = (plan.num_lanes if plan else 0) + (tandem.num_lanes if tandem else 0)
+        times = []
+        for _ in range(repeats):
+            perturb_loads(system)
+            t0 = time.perf_counter()
+            calculate_fleet(system, backend=backend)
+            optimize(system, opt)
+            times.append((time.perf_counter() - t0) * 1000.0)
+        return {
+            "variants": n,
+            "lanes": lanes,
+            "sizing_ms": round(min(times), 1),  # min: 2-core box noise
+            "sizing_ms_all": [round(t, 1) for t in times],
+        }
+
+    curve = [run_curve(n, 1) for n in sizes]
+
+    # scalar oracle comparator at the smallest size only
+    reset_fleet_state()
+    spec0 = fleet_system_spec(sizes[0], shapes_per_variant=1)
+    system0 = System(spec0)
+    t0 = time.perf_counter()
+    system0.calculate_all()
+    optimize(system0, spec0.optimizer)
+    scalar_small_ms = (time.perf_counter() - t0) * 1000.0
+
+    stress = run_curve(max(sizes), 2)
+    reset_fleet_state()
+
+    small, large = curve[0], curve[-1]
+    budget_ms = 5.0 * BENCH_R05_CYCLE_MS
+    per_variant_ratio = (
+        (large["sizing_ms"] / large["variants"])
+        / (small["sizing_ms"] / small["variants"])
+    )
+    return {
+        "backend": backend,
+        "platform": jax.default_backend(),
+        "repeats": repeats,
+        "curve": curve,
+        "scalar_oracle": {
+            "variants": sizes[0],
+            "sizing_ms": round(scalar_small_ms, 1),
+            "vs_vectorized": round(
+                scalar_small_ms / max(small["sizing_ms"], 1e-6), 1
+            ),
+        },
+        "stress_2_shapes": stress,
+        # acceptance (ISSUE-6): a 10k-variant pass within 5x the
+        # 200-variant BENCH_r05 optimized cycle time, i.e. sublinear
+        "bench_r05_cycle_ms": BENCH_R05_CYCLE_MS,
+        "budget_ms": budget_ms,
+        "largest_within_budget": large["sizing_ms"] <= budget_ms,
+        # <1.0 = per-variant cost SHRANK as the fleet grew (sublinear)
+        "per_variant_scaling": round(per_variant_ratio, 3),
+        "provenance": (
+            f"{backend} backend on {jax.default_backend()}; honest "
+            "every-variant-changed passes (rates perturbed between "
+            "repeats, min-of-N against box noise); edge variants "
+            "(tandem/zero-load/pinned/infeasible) included; scalar "
+            "oracle timed at the smallest size only"
+        ),
+    }
+
+
 def fleet_cycle_metrics(full: bool = True) -> dict:
     spec = build_spec(64)  # 64 variants x 8 shapes = 512 lanes
     opt = spec.optimizer
@@ -1165,7 +1277,8 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
                        calibrated: dict | None = None,
                        trace: dict | None = None,
                        predictive: dict | None = None,
-                       reconcile_cycle: dict | None = None) -> dict:
+                       reconcile_cycle: dict | None = None,
+                       sizing: dict | None = None) -> dict:
     """Everything the bench measures, in one document — written to
     `bench_full.json`, NOT printed (the printed line is `compact_line`)."""
     return {
@@ -1220,12 +1333,17 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
         # coalesced collection + concurrency + sizing cache against the
         # per-variant serial path, miniprom-backed
         **({"reconcile_cycle": reconcile_cycle} if reconcile_cycle else {}),
+        # vectorized-sizing scaling curve, 200 -> 10k variants (ISSUE-6):
+        # one jitted solve per cycle on every backend, snapshot-packed
+        **({"sizing": sizing} if sizing else {}),
     }
 
 
 # optional `extra` fields in drop order on a 1024-byte overflow: least
 # headline-critical first (the full payload always carries everything)
 _COMPACT_DROP_ORDER = (
+    "sizing_10k_ms",
+    "sizing_per_variant_scaling",
     "reconcile_speedup",
     "reconcile_query_reduction",
     "fleet_cycle_platform",
@@ -1244,7 +1362,8 @@ _COMPACT_DROP_ORDER = (
 def compact_line(ns: dict, cycles: dict, tpu_probe: dict,
                  measured_p99: dict | None = None,
                  calibrated: dict | None = None,
-                 reconcile_cycle: dict | None = None) -> str:
+                 reconcile_cycle: dict | None = None,
+                 sizing: dict | None = None) -> str:
     """The ONE printed JSON line. Round-4 postmortem: the driver captures
     only a tail window of stdout, and round 4's ~4 KB single line was cut
     mid-object (`BENCH_r04.json parsed: null`) — a benchmark whose number
@@ -1268,6 +1387,9 @@ def compact_line(ns: dict, cycles: dict, tpu_probe: dict,
         **({"reconcile_speedup": reconcile_cycle["speedup"],
             "reconcile_query_reduction": reconcile_cycle["query_reduction"]}
            if reconcile_cycle and "speedup" in reconcile_cycle else {}),
+        **({"sizing_10k_ms": sizing["curve"][-1]["sizing_ms"],
+            "sizing_per_variant_scaling": sizing["per_variant_scaling"]}
+           if sizing and "curve" in sizing else {}),
         **({"p99_ttft_measured_ms": measured_p99["p99_ttft_ms"],
             "p99_meets_slo": measured_p99["meets_slo"]}
            if measured_p99 else {}),
@@ -1321,9 +1443,25 @@ def main() -> None:
                          "(make bench-cycle) and print its JSON")
     ap.add_argument("--cycle-variants", type=int, default=200,
                     help="fleet size for the reconcile-cycle benchmark")
+    ap.add_argument("--sizing", action="store_true",
+                    help="run ONLY the vectorized-sizing scaling benchmark "
+                         "(make bench-sizing: 200 -> 10k variants), print "
+                         "its JSON, and merge it into bench_full.json")
     args = ap.parse_args()
     if args.cycle:
         print(json.dumps(reconcile_cycle_bench(args.cycle_variants)))
+        return
+    if args.sizing:
+        _pin_cpu_if_tpu_unreachable()  # a hung tunnel must not stall the bench
+        sizing = sizing_scaling_bench()
+        payload = Path(FULL_PAYLOAD_PATH)
+        try:
+            full = json.loads(payload.read_text()) if payload.exists() else {}
+        except (OSError, json.JSONDecodeError):
+            full = {}
+        full["sizing"] = sizing
+        payload.write_text(json.dumps(full, indent=1) + "\n")
+        print(json.dumps(sizing))
         return
     from inferno_tpu.obs import Tracer
 
@@ -1361,6 +1499,17 @@ def main() -> None:
             sp.set(error=str(e))
     with tracer.span("fleet-cycle-timing"):
         cycles = fleet_cycle_metrics(full=not args.quick)
+    # vectorized-sizing scaling curve (ISSUE-6): guarded — a regression
+    # here must never abort the headline; --quick trims the curve
+    with tracer.span("sizing-scaling") as sp:
+        try:
+            sizing = sizing_scaling_bench(
+                sizes=(200, 1000) if args.quick else (200, 1000, 3000, 10000),
+                repeats=3 if args.quick else 4,
+            )
+        except Exception as e:  # noqa: BLE001 — artifact must survive
+            sizing = {"error": f"{type(e).__name__}: {e}"}
+            sp.set(error=str(e))
     # whole-reconcile I/O benchmark (ISSUE-5): guarded like the other
     # optional phases — a regression here must never abort the headline
     with tracer.span("reconcile-cycle-bench") as sp:
@@ -1376,11 +1525,12 @@ def main() -> None:
                                       calibrated,
                                       trace=tracer.finish().to_dict(),
                                       predictive=predictive,
-                                      reconcile_cycle=reconcile_cycle),
+                                      reconcile_cycle=reconcile_cycle,
+                                      sizing=sizing),
                    indent=1) + "\n"
     )
     print(compact_line(ns, cycles, tpu_probe, measured, calibrated,
-                       reconcile_cycle))
+                       reconcile_cycle, sizing))
 
 
 if __name__ == "__main__":

@@ -23,10 +23,12 @@ controller.go:516-582):
   METRICS_TLS_CERT_PATH/KEY_PATH  serve /metrics over TLS, certs reloaded
                                 on rotation; plain HTTP when unset
   HEALTH_PORT                   (default 8081; liveness/readiness probes)
-  COMPUTE_BACKEND               auto | tpu | tpu-pallas | native | scalar
+  COMPUTE_BACKEND               auto | tpu | tpu-pallas | jax | native | scalar
                                 (default auto: tpu if a device is attached,
-                                else native, else scalar — the resolution is
-                                logged; USE_TPU_FLEET=false maps to scalar)
+                                else native, else jax — every resolution is a
+                                batched backend and is logged; "scalar" is the
+                                per-variant parity oracle, reached only
+                                explicitly or via USE_TPU_FLEET=false)
   DIRECT_SCALE                  true|false (default false; HPA otherwise)
   LEADER_ELECT                  true|false (default false; lease-based
                                 election for multi-replica deployments)

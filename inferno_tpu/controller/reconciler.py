@@ -124,7 +124,11 @@ def _tpu_device_present(timeout_s: float = 20.0) -> bool:
 
 def resolve_compute_backend() -> str:
     """'auto' resolution: tpu if a device is present, else the C++ native
-    solver if it builds/loads, else the scalar fallback."""
+    solver if it builds/loads, else the jitted XLA kernel on CPU ("jax").
+
+    Every resolution lands on a BATCHED backend: the per-variant scalar
+    loop (`System.calculate_all`) is a parity oracle, reachable only by
+    configuring `compute_backend="scalar"` explicitly."""
     from inferno_tpu.controller.logger import get_logger
 
     # announce BEFORE the probe (r4 advisor): if the probe has to wait
@@ -137,7 +141,7 @@ def resolve_compute_backend() -> str:
         return "tpu"
     from inferno_tpu import native
 
-    return "native" if native.available() else "scalar"
+    return "native" if native.available() else "jax"
 
 
 @dataclasses.dataclass
@@ -146,21 +150,27 @@ class ReconcilerConfig:
     engine: str = "vllm-tpu"  # serving engine metric vocabulary
     scale_to_zero: bool = False  # reference env WVA_SCALE_TO_ZERO (utils.go:282-285)
     # candidate-sizing backend: "auto" (tpu if a TPU device is attached,
-    # else the C++ native solver, else scalar — resolved once at
-    # Reconciler init and logged), "tpu" (batched XLA kernel),
-    # "tpu-pallas" (batched XLA + fused pallas stationary solve),
-    # "native" (C++ solver, no TPU attachment needed), or "scalar"
-    # (pure-Python loop). "auto" is the default because the normal
-    # production topology deploys the controller pod WITHOUT a TPU
-    # attachment — there the native backend is the fast path, and a
-    # hardcoded "tpu" default would silently run the XLA kernel on a
-    # slow CPU fallback (round-3 verdict weak #2).
+    # else the C++ native solver, else the jitted XLA kernel on CPU —
+    # resolved once at Reconciler init and logged), "tpu" (batched XLA
+    # kernel), "tpu-pallas" (batched XLA + fused pallas stationary
+    # solve), "jax" (batched XLA kernel on whatever device jax has; the
+    # CPU tensor-program path), "native" (C++ solver, no TPU attachment
+    # needed), or "scalar" (the per-variant pure-Python loop, kept as a
+    # PARITY ORACLE — never auto-selected; every production resolution is
+    # a batched backend driving parallel/fleet.py's one-jitted-solve
+    # pipeline). "auto" is the default because the normal production
+    # topology deploys the controller pod WITHOUT a TPU attachment —
+    # there native/jax are the fast paths, and a hardcoded "tpu" default
+    # would silently run the XLA kernel on a slow CPU fallback (round-3
+    # verdict weak #2).
     compute_backend: str = "auto"
 
     def __post_init__(self) -> None:
-        if self.compute_backend not in ("auto", "tpu", "tpu-pallas", "native", "scalar"):
+        if self.compute_backend not in (
+            "auto", "tpu", "tpu-pallas", "jax", "native", "scalar"
+        ):
             raise ValueError(
-                f"compute_backend must be auto|tpu|tpu-pallas|native|scalar, "
+                f"compute_backend must be auto|tpu|tpu-pallas|jax|native|scalar, "
                 f"got {self.compute_backend!r}"
             )
         if self.scale_down_stabilization_s < 0:
@@ -356,7 +366,7 @@ class Reconciler:
             self.config = dataclasses.replace(self.config, compute_backend=resolved)
             self.log.info(
                 "compute_backend auto-resolved to %r "
-                "(tpu if a device is attached, else native, else scalar)",
+                "(tpu if a device is attached, else native, else jax-on-cpu)",
                 resolved,
             )
         if self.config.profile_correction:
@@ -1139,7 +1149,10 @@ class Reconciler:
                     else {n for n in system.servers if n not in cached_names}
                 )
                 if to_size is None or to_size:
-                    if self.config.compute_backend in ("tpu", "tpu-pallas", "native"):
+                    if self.config.compute_backend != "scalar":
+                        # every batched backend (tpu, tpu-pallas, jax,
+                        # native) routes through the vectorized fleet
+                        # pipeline; "scalar" is the explicit parity oracle
                         from inferno_tpu.parallel import calculate_fleet
 
                         calculate_fleet(

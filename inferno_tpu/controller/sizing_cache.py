@@ -99,7 +99,15 @@ def server_signature(server, system, global_fp: tuple) -> tuple | None:
 class _Entry:
     arrival_rate: float
     signature: tuple
-    allocations: dict[str, Allocation]  # value-less clones
+    # Solve-time candidates, held by REFERENCE: nothing mutates a
+    # candidate Allocation after the solve (greedy clones before
+    # scaling), and every replay clones before touching `value`. For a
+    # lazy `parallel.fleet.LaneAllocations` this defers per-lane
+    # materialization to the first hit — storing must stay O(1) so the
+    # cache doesn't reinstate the O(lanes) writeback the lazy view
+    # removed. The view pins its cycle-scoped result arrays (one shared
+    # source per solve, bounded by max_age_cycles entries).
+    allocations: dict[str, Allocation]
     hits_served: int = 0
 
 
@@ -171,7 +179,7 @@ class SizingCache:
         self._entries[name] = _Entry(
             arrival_rate=arrival_rate,
             signature=signature,
-            allocations={acc: a.clone() for acc, a in allocations.items()},
+            allocations=allocations,
         )
 
     def invalidate(self, name: str) -> None:

@@ -107,6 +107,14 @@ class ServiceClass:
 
     def __init__(self, spec: ServiceClassSpec):
         self.spec = spec
+        # model -> target index: the spec's list scan is O(targets) and
+        # target_for runs per server per cycle — at fleet scale (10k
+        # variants sharing one class) the scan alone is O(variants^2)
+        # and dominates the sizing pass. setdefault keeps the FIRST
+        # occurrence per model, matching the spec scan's first-match.
+        self._targets: dict[str, ModelTarget] = {}
+        for t in spec.model_targets:
+            self._targets.setdefault(t.model, t)
 
     @property
     def name(self) -> str:
@@ -117,7 +125,7 @@ class ServiceClass:
         return self.spec.priority
 
     def target_for(self, model: str) -> ModelTarget | None:
-        return self.spec.target_for(model)
+        return self._targets.get(model)
 
 
 class Server:

@@ -3,10 +3,13 @@
 `solve_stats` is a drop-in replacement for the XLA-composed
 `ops.queueing._solve_stats` — the op executed ~2x32 times per fleet
 sizing (once per bisection iteration per SLO target). The kernel fuses
-the whole per-iteration pipeline over the [P, K] occupancy grid:
+the whole per-iteration pipeline over the [P, K] head grid
+(k = 1..max_batch; the geometric queue tail is folded in closed form via
+`ops.queueing._fold_tail`, exactly as the XLA path does):
 
-    body   = k·log(lam) − cml            (log stationary weights)
+    body   = k·log(lam) − cml            (log stationary weights, head)
     m, Z   = streaming logsumexp         (incl. the k=0 term)
+    tail   = closed-form geometric sums  (mass / queue length / blocking)
     stats  = in-system / in-servers / blocking-mass reductions
 
 into one VMEM-resident pass, so the grid is read from HBM exactly once
@@ -16,9 +19,9 @@ needs the same reductions but fuses them less aggressively (separate
 reduce fusions re-read the grid).
 
 Tiling: each program instance handles TILE_P=8 lanes × the full padded K
-(multiple of 128, f32 ⇒ (8, 128) tile granularity on the VPU; K ≤ ~3k ⇒
-≤ ~96 KB of VMEM per instance). Lanes are padded to a multiple of
-TILE_P with neutral parameters.
+(multiple of 128, f32 ⇒ (8, 128) tile granularity on the VPU; K is now
+the max-batch pad, ≤ ~512 ⇒ ≤ ~16 KB of VMEM per instance). Lanes are
+padded to a multiple of TILE_P with neutral parameters.
 
 On non-TPU backends the kernel runs in interpret mode, so tests exercise
 the exact kernel code path on CPU.
@@ -32,39 +35,41 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from inferno_tpu.ops.queueing import _fold_tail
+
 TILE_P = 8  # lanes per program instance (f32 sublane count)
 
 
-def _stats_kernel(cml_ref, lam_ref, nmax_ref, cap_ref, out_ref):
-    cml = cml_ref[...]  # [TILE_P, K]; +inf beyond each lane's cap
+def _stats_kernel(cml_ref, lam_ref, nmax_ref, lmf_ref, tlen_ref, out_ref):
+    cml = cml_ref[...]  # [TILE_P, K]; +inf beyond each lane's max batch
     lam = lam_ref[...]  # [TILE_P, 1]
     nmax = nmax_ref[...]  # [TILE_P, 1]
-    cap = cap_ref[...]  # [TILE_P, 1] (f32 state index of the blocking state)
+    log_mu_full = lmf_ref[...]  # [TILE_P, 1] tail service rate, log req/msec
+    tail_len = tlen_ref[...]  # [TILE_P, 1] queue states beyond max batch
 
     # state indices k = 1..K (TPU needs >= 2D integer iota)
     kk = jax.lax.broadcasted_iota(jnp.int32, cml.shape, 1).astype(jnp.float32) + 1.0
 
     # log p[k] up to normalization; k=0 term is 0 by construction
-    body = kk * jnp.log(lam) - cml  # -inf beyond cap => weight 0
+    body = kk * jnp.log(lam) - cml  # -inf beyond max batch => weight 0
 
-    m = jnp.maximum(jnp.max(body, axis=1, keepdims=True), 0.0)
-    e = jnp.exp(body - m)  # [TILE_P, K]
-    p0 = jnp.exp(-m)  # the k=0 term
-    z = p0 + jnp.sum(e, axis=1, keepdims=True)
-
-    le_n = kk <= nmax
-    ke = kk * e
-    # queue mass summed directly (never 1 - mass_le_n: the complement is
-    # f32 rounding noise at low load, amplified by nmax — see ops.queueing)
-    mass_gt_n = jnp.sum(jnp.where(le_n, 0.0, e), axis=1, keepdims=True) / z
-    in_servers = (
-        jnp.sum(jnp.where(le_n, ke, 0.0), axis=1, keepdims=True) / z
-        + nmax * mass_gt_n
+    m_head = jnp.maximum(jnp.max(body, axis=1, keepdims=True), 0.0)
+    # log-weight of the full-batch state N (the geometric tail's anchor)
+    logp_n = jnp.max(
+        jnp.where(kk == nmax, body, -jnp.inf), axis=1, keepdims=True
     )
-    # queue length directly as sum_{k>n} (k-n) p[k]: avoids the f32
-    # cancellation of the in_system - in_servers formulation
-    q_len = jnp.sum(jnp.where(le_n, 0.0, (kk - nmax) * e), axis=1, keepdims=True) / z
-    p_block = jnp.sum(jnp.where(kk == cap, e, 0.0), axis=1, keepdims=True) / z
+    m, z_tail, jsum_tail, p_block_u = _fold_tail(
+        m_head, logp_n, jnp.log(lam) - log_mu_full, tail_len
+    )
+    e = jnp.exp(body - m)  # [TILE_P, K]
+    z = jnp.exp(-m) + jnp.sum(e, axis=1, keepdims=True) + z_tail
+    sk_head = jnp.sum(kk * e, axis=1, keepdims=True)
+    # every tail state holds exactly nmax in service; queue length comes
+    # DIRECTLY from the tail sum (never in_system - in_servers: the
+    # difference is f32 cancellation noise at low load — see ops.queueing)
+    in_servers = (sk_head + nmax * z_tail) / z
+    q_len = jsum_tail / z
+    p_block = p_block_u / z
 
     tput = lam * (1.0 - p_block)
     serv = in_servers / tput
@@ -73,7 +78,7 @@ def _stats_kernel(cml_ref, lam_ref, nmax_ref, cap_ref, out_ref):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _solve(cml, lam, nmax, cap, interpret: bool):
+def _solve(cml, lam, nmax, log_mu_full, tail_len, interpret: bool):
     p, k = cml.shape
     grid = (p // TILE_P,)
     out = pl.pallas_call(
@@ -85,10 +90,11 @@ def _solve(cml, lam, nmax, cap, interpret: bool):
             pl.BlockSpec((TILE_P, 1), lambda i: (i, 0)),
             pl.BlockSpec((TILE_P, 1), lambda i: (i, 0)),
             pl.BlockSpec((TILE_P, 1), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_P, 1), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((TILE_P, 4), lambda i: (i, 0)),
         interpret=interpret,
-    )(cml, lam, nmax, cap)
+    )(cml, lam, nmax, log_mu_full, tail_len)
     return out
 
 
@@ -107,13 +113,15 @@ def solve_stats(lam: jax.Array, grid, interpret: bool | None = None):
     pad = (-p) % TILE_P
     cml = grid.cml.astype(jnp.float32)
     nmax = grid.nmax.astype(jnp.float32)[:, None]
-    cap = grid.cap_idx.astype(jnp.float32)
+    lmf = grid.log_mu_full.astype(jnp.float32)[:, None]
+    tlen = grid.tail_len.astype(jnp.float32)[:, None]
     lam2 = lam.astype(jnp.float32)[:, None]
     if pad:
-        # neutral lane: mu(k)=1 (cml=0 -> weights lam^k), lam=0.5, cap=1
+        # neutral lane: mu(k)=1 (cml=0 -> weights lam^k), lam=0.5, no tail
         cml = jnp.pad(cml, ((0, pad), (0, 0)))
         nmax = jnp.pad(nmax, ((0, pad), (0, 0)), constant_values=1.0)
-        cap = jnp.pad(cap, ((0, pad), (0, 0)), constant_values=1.0)
+        lmf = jnp.pad(lmf, ((0, pad), (0, 0)))
+        tlen = jnp.pad(tlen, ((0, pad), (0, 0)))
         lam2 = jnp.pad(lam2, ((0, pad), (0, 0)), constant_values=0.5)
-    out = _solve(cml, lam2, nmax, cap, interpret)[:p]
+    out = _solve(cml, lam2, nmax, lmf, tlen, interpret)[:p]
     return out[:, 0], out[:, 1], out[:, 2], out[:, 3]

@@ -1,9 +1,11 @@
 from inferno_tpu.parallel.fleet import (
     FleetPlan,
+    LaneAllocations,
     TandemPlan,
     build_fleet,
     build_tandem_fleet,
     calculate_fleet,
+    reset_fleet_state,
     solve_fleet,
     solve_tandem_fleet,
 )
@@ -11,10 +13,12 @@ from inferno_tpu.parallel.mesh import fleet_mesh, shard_fleet_params
 
 __all__ = [
     "FleetPlan",
+    "LaneAllocations",
     "TandemPlan",
     "build_fleet",
     "build_tandem_fleet",
     "calculate_fleet",
+    "reset_fleet_state",
     "solve_fleet",
     "solve_tandem_fleet",
     "fleet_mesh",

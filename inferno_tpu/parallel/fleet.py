@@ -28,7 +28,7 @@ from inferno_tpu.core.allocation import (
     transition_penalty,
 )
 from inferno_tpu.core.system import System
-from inferno_tpu.config.defaults import MAX_QUEUE_TO_BATCH_RATIO
+from inferno_tpu.config.defaults import ACCEL_PENALTY_FACTOR, MAX_QUEUE_TO_BATCH_RATIO
 from inferno_tpu.ops.queueing import (
     DEFAULT_BISECT_ITERS,
     FleetParams,
@@ -38,15 +38,26 @@ from inferno_tpu.ops.queueing import (
 )
 from inferno_tpu.parallel.mesh import fleet_mesh, shard_fleet_params
 
-_K_PAD = 128  # occupancy grid padded to a multiple of this (fewer recompiles)
+_K_PAD = 128  # head (max-batch) grid padded to this floor (fewer recompiles;
+# also the pallas f32 tile lane width, so the kernel grid stays tileable)
 
 
 @dataclasses.dataclass
 class FleetPlan:
-    """A flattened fleet batch plus the lane -> (server, acc) mapping."""
+    """A flattened fleet batch plus the lane -> (server, acc) mapping.
+
+    `server_idx`/`acc_rank` (set by the snapshot packer) feed the
+    vectorized per-server candidate argmin in `calculate_fleet`: lane ->
+    position in the system's server order, and lane accelerator ->
+    sorted-catalog rank (the deterministic tie-break axis). Legacy-built
+    plans leave them None and `calculate_fleet` derives both from
+    `lanes` — the arrays are only valid for the system they were built
+    against, which the snapshot's version key guarantees."""
 
     params: FleetParams
     lanes: list[tuple[str, str]]  # (server_name, acc_name) per lane
+    server_idx: np.ndarray | None = None
+    acc_rank: np.ndarray | None = None
 
     @property
     def num_lanes(self) -> int:
@@ -59,6 +70,8 @@ class TandemPlan:
 
     params: TandemParams
     lanes: list[tuple[str, str]]  # (server_name, acc_name) per lane
+    server_idx: np.ndarray | None = None
+    acc_rank: np.ndarray | None = None
 
     @property
     def num_lanes(self) -> int:
@@ -148,11 +161,11 @@ def _shared_cols(cols: dict[str, list], lane: _LaneBasis) -> None:
     cols["cost_per_replica"].append(lane.cost_per_replica)
 
 
-# Lane-set memo (one slot per lane kind): an unchanged fleet re-packs
-# into bit-identical columns, so the previous cycle's FleetParams arrays
-# are reused and the pipeline goes straight to the jitted call (whose
-# own cache is keyed by shape). Keyed by the full column content — any
-# lane added, removed, re-parameterized, or re-loaded misses.
+# Lane-set memo (one slot per lane kind): an unchanged fleet replays the
+# previous cycle's plan OBJECT, so the pipeline goes straight to the
+# jitted call (whose own cache is keyed by shape). On the snapshot path
+# the key is (snapshot version, only-subset) — an O(1) check; the legacy
+# walk (FLEET_SNAPSHOT=0) still keys on the full column content.
 _plan_memo: dict[str, tuple[tuple, object]] = {}
 
 
@@ -165,10 +178,66 @@ def _memoized_plan(kind: str, key: tuple, build):
     return plan
 
 
+def _snapshot_enabled() -> bool:
+    import os
+
+    return os.environ.get("FLEET_SNAPSHOT", "true").lower() not in (
+        "0", "false", "no", "off",
+    )
+
+
+_snapshot = None  # lazily-created module singleton (parallel.snapshot)
+
+
+def _get_snapshot():
+    global _snapshot
+    if _snapshot is None:
+        from inferno_tpu.parallel.snapshot import FleetSnapshot
+
+        _snapshot = FleetSnapshot()
+    return _snapshot
+
+
+def _snapshot_plan(system: System, only: set[str] | None, kind: str):
+    """Columnar-snapshot packing: O(servers) change detection + O(lanes)
+    numpy, with an O(1) version-keyed memo — replaces the per-lane
+    Python walk of the legacy builders below."""
+    snap = _get_snapshot()
+    version = snap.update(system)
+    key = (version, None if only is None else frozenset(only))
+
+    def build():
+        rows, lanes = snap.rows(kind, only)
+        if not lanes:
+            return None
+        cols = snap.columns(kind, rows)
+        server_idx, acc_rank = snap.meta(kind, rows)
+        cls, pcls = (
+            (FleetPlan, FleetParams) if kind == "agg" else (TandemPlan, TandemParams)
+        )
+        return cls(
+            params=pcls(**cols), lanes=lanes,
+            server_idx=server_idx, acc_rank=acc_rank,
+        )
+
+    return _memoized_plan(f"snap-{kind}", key, build)
+
+
+def reset_fleet_state() -> None:
+    """Drop every cross-cycle cache (plan memo, solve memo, snapshot) —
+    test isolation hook."""
+    _plan_memo.clear()
+    _solve_memo.clear()
+    if _snapshot is not None:
+        _snapshot.reset()
+
+
 def build_fleet(system: System, only: set[str] | None = None) -> FleetPlan | None:
     """Flatten all loaded aggregated (server, slice-shape) pairs into a
     FleetParams. Mesh padding happens per occupancy bucket in
     `solve_fleet`, not here."""
+    if _snapshot_enabled():
+        return _snapshot_plan(system, only, "agg")
     cols: dict[str, list] = {name: [] for name in FleetParams._fields}
     lanes: list[tuple[str, str]] = []
 
@@ -217,6 +286,8 @@ def build_tandem_fleet(system: System, only: set[str] | None = None) -> TandemPl
     (create_allocation + build_disagg_analyzer): lanes the scalar analyzer
     would reject (no prefill stage, invalid spec, non-positive stage
     times) produce no candidate here either."""
+    if _snapshot_enabled():
+        return _snapshot_plan(system, only, "tan")
     cols: dict[str, list] = {name: [] for name in TandemParams._fields}
     lanes: list[tuple[str, str]] = []
 
@@ -279,14 +350,19 @@ def build_tandem_fleet(system: System, only: set[str] | None = None) -> TandemPl
 _fn_cache: dict[tuple[tuple[tuple[str, int], ...], int, bool], object] = {}
 
 
-def _bucket_k(cap: int) -> int:
-    """Pad an occupancy cap to the next 4x-geometric grid size (>= _K_PAD).
+def _bucket_k(batch: int) -> int:
+    """Pad a lane's max batch to the next 4x-geometric grid size
+    (>= _K_PAD).
 
-    Coarse steps trade some padded compute for fewer compiled programs
-    and fewer device round-trips per cycle (dispatch latency dominates on
-    small grids, especially over a tunneled TPU backend)."""
+    Since the queue tail beyond max_batch is folded in closed form
+    (ops.queueing._fold_tail), the grid only spans the head states
+    k <= max_batch — an ~11x smaller tensor than the occupancy-cap grids
+    of r01-r05 at the default queue ratio. Coarse steps trade some padded
+    compute for fewer compiled programs and fewer device round-trips per
+    cycle (dispatch latency dominates on small grids, especially over a
+    tunneled TPU backend)."""
     k = _K_PAD
-    while k < cap:
+    while k < batch:
         k *= 4
     return k
 
@@ -306,14 +382,22 @@ def pad_params_rows(params, total: int):
 
 
 def _pad_lanes(n: int, chunk: int) -> int:
-    """Pad a bucket's lane count to the next power of two (>= 8), then to a
-    multiple of the mesh chunk. The fused multi-bucket program's jit cache
-    is keyed by every bucket's lane count, so without coarse padding any
-    single variant added to or removed from the fleet would recompile the
-    whole pipeline; with it, counts are stable within a 2x band."""
+    """Pad a bucket's lane count to the next power of two (>= 8) up to
+    8192, then to a multiple of 4096, then to a multiple of the mesh
+    chunk. The fused multi-bucket program's jit cache is keyed by every
+    bucket's lane count, so without coarse padding any single variant
+    added to or removed from the fleet would recompile the whole
+    pipeline. Power-of-two steps keep small fleets stable within a 2x
+    band; above 8k lanes the band switches to 4096-lane increments —
+    at 10k-variant scale a 2x band would waste up to half the solve on
+    dummy lanes (the padded tail dominated the 10k CPU sizing pass),
+    while 4096-steps bound the waste at ~12% and still only recompile
+    when the fleet crosses a 4k-lane boundary."""
     padded = 8
-    while padded < n:
+    while padded < n and padded < 8192:
         padded *= 2
+    if padded < n:
+        padded = -(-n // 4096) * 4096
     return padded + ((-padded) % chunk)
 
 
@@ -368,10 +452,12 @@ def _solve_all(
 ) -> tuple[FleetResult | None, FleetResult | None]:
     """Solve aggregated and tandem lanes in ONE fused jitted program.
 
-    Lanes are grouped into power-of-two occupancy buckets per kind and
-    solved per bucket: per-lane K varies by orders of magnitude across
-    slice shapes, and a single global grid would make every small lane pay
-    for the largest one. Buckets keep shapes static (one compilation per
+    Lanes are grouped into geometric max-batch buckets per kind and
+    solved per bucket: per-lane batch varies by orders of magnitude
+    across slice shapes, and a single global grid would make every small
+    lane pay for the largest one. (The occupancy cap no longer affects
+    the grid — queue tails are folded in closed form by the kernels.)
+    Buckets keep shapes static (one compilation per
     (kind, K, padded-lane-count) signature, cached across cycles).
     """
     chunk = mesh.size if mesh is not None else 1
@@ -379,11 +465,11 @@ def _solve_all(
     specs: list[tuple[str, int]] = []
     slots: list[tuple[str, np.ndarray, int]] = []  # (kind, orig indices, width)
 
-    def add(kind: str, params_np, bucket_caps: np.ndarray):
+    def add(kind: str, params_np, bucket_batches: np.ndarray):
         cls = type(params_np)
         buckets: dict[int, list[int]] = {}
-        for i, cap in enumerate(bucket_caps):
-            buckets.setdefault(_bucket_k(int(cap)), []).append(i)
+        for i, batch in enumerate(bucket_batches):
+            buckets.setdefault(_bucket_k(int(batch)), []).append(i)
         for k_bucket, idx_list in sorted(buckets.items()):
             idx = np.asarray(idx_list)
             sub = cls(*(a[idx] for a in params_np))
@@ -399,11 +485,11 @@ def _solve_all(
     if plan is not None and plan.num_lanes:
         agg_out = _empty_result(plan.num_lanes)
         params_np = jax.tree.map(np.asarray, plan.params)
-        add("agg", params_np, params_np.occupancy_cap)
+        add("agg", params_np, params_np.max_batch)
     if tandem is not None and tandem.num_lanes:
         tan_out = _empty_result(tandem.num_lanes)
         tp_np = jax.tree.map(np.asarray, tandem.params)
-        add("tan", tp_np, np.maximum(tp_np.prefill_cap, tp_np.decode_cap))
+        add("tan", tp_np, np.maximum(tp_np.prefill_batch, tp_np.decode_batch))
     if not subs:
         return agg_out, tan_out
 
@@ -451,6 +537,122 @@ def solve_tandem_fleet(
 _solve_memo: dict = {}
 
 
+class _LaneSource:
+    """Per-cycle context the lazy allocations materialize from: the solved
+    plans/results plus the vectorized f64 transition-penalty values (bit
+    identical to scalar `transition_penalty` on the same f32 results)."""
+
+    __slots__ = ("plans", "results", "values", "batches")
+
+    def __init__(self):
+        self.plans: dict[str, object] = {}
+        self.results: dict[str, object] = {}
+        self.values: dict[str, np.ndarray] = {}
+        self.batches: dict[str, np.ndarray] = {}
+
+    def add(self, kind, plan, result, values, batches) -> None:
+        self.plans[kind] = plan
+        self.results[kind] = result
+        self.values[kind] = values
+        self.batches[kind] = batches
+
+    def materialize(self, kind: str, lane: int) -> Allocation:
+        res = self.results[kind]
+        _, acc = self.plans[kind].lanes[lane]
+        alloc = Allocation(
+            accelerator=acc,
+            num_replicas=int(res.num_replicas[lane]),
+            batch_size=int(self.batches[kind][lane]),
+            cost=float(res.cost[lane]),
+            itl=float(res.itl[lane]),
+            ttft=float(res.ttft[lane]),
+            rho=float(res.rho[lane]),
+            max_arrv_rate_per_replica=float(res.rate_star[lane]) / 1000.0,
+        )
+        alloc.value = float(self.values[kind][lane])
+        return alloc
+
+
+class LaneAllocations(dict):
+    """`server.all_allocations` for a laned server: dict[acc, Allocation]
+    whose entries materialize lazily from the vectorized fleet results.
+
+    The unlimited solver consumes only `best()` — the per-server argmin
+    precomputed VECTORIZED in `calculate_fleet` — so the common cycle
+    materializes exactly one Allocation per server instead of one per
+    lane. Any ordinary dict access (`values()`, `in`, `len`, `==`, and
+    `dict(...)`/`{**...}`, whose C fast path is disabled by the __iter__
+    override) materializes the full candidate set first, so the greedy
+    solver, the sizing cache, and tests see plain-dict semantics.
+    copy/pickle produce a PLAIN dict of the materialized entries (the
+    lazy view holds cycle-scoped array refs not worth carrying).
+    """
+
+    __slots__ = ("_src", "_kinds", "_lanes", "_best")
+
+    _KIND = ("agg", "tan")
+
+    def __init__(self, src: _LaneSource, kinds, lanes, best: tuple | None):
+        super().__init__()
+        self._src = src
+        self._kinds = kinds  # per-entry kind ids (0=agg, 1=tan), lane order
+        self._lanes = lanes  # per-entry lane index into that kind's plan
+        self._best = best  # (kind_id, lane) of the min-(value, cost, acc) lane
+
+    def _ensure(self) -> None:
+        if self._src is None:
+            return
+        src, self._src = self._src, None
+        for kind_id, lane in zip(self._kinds, self._lanes):
+            alloc = src.materialize(self._KIND[kind_id], int(lane))
+            # best() may have landed this lane already; keep its identity
+            if not dict.__contains__(self, alloc.accelerator):
+                dict.__setitem__(self, alloc.accelerator, alloc)
+
+    def best(self) -> Allocation | None:
+        """The minimum-(value, cost, accelerator) candidate, materializing
+        only that lane when the rest of the dict was never touched."""
+        if self._best is None:
+            return None
+        if self._src is not None:
+            kind_id, lane = self._best
+            kind = self._KIND[kind_id]
+            acc = self._src.plans[kind].lanes[int(lane)][1]
+            if not dict.__contains__(self, acc):  # raw check: stay lazy
+                alloc = self._src.materialize(kind, int(lane))
+                dict.__setitem__(self, alloc.accelerator, alloc)
+                return alloc
+            return dict.__getitem__(self, acc)
+        return min(
+            dict.values(self),
+            key=lambda a: (a.value, a.cost, a.accelerator),
+            default=None,
+        )
+
+    def __reduce__(self):  # copy/pickle: materialize into a plain dict
+        self._ensure()
+        return (dict, (list(dict.items(self)),))
+
+
+def _lazy(name):
+    def method(self, *args, **kwargs):
+        self._ensure()
+        return getattr(dict, name)(self, *args, **kwargs)
+
+    method.__name__ = name
+    return method
+
+
+for _name in (
+    "__getitem__", "__iter__", "__len__", "__contains__", "__eq__", "__ne__",
+    "__repr__", "__or__", "__ror__", "__setitem__", "__delitem__",
+    "get", "keys", "values", "items", "copy", "pop", "popitem",
+    "setdefault", "update", "clear",
+):
+    setattr(LaneAllocations, _name, _lazy(_name))
+del _name
+
+
 def calculate_fleet(
     system: System,
     mesh: jax.sharding.Mesh | None = None,
@@ -462,12 +664,20 @@ def calculate_fleet(
 
     `backend` selects the batched solver: "tpu" (the jitted XLA kernel,
     optionally sharded over `mesh`), "tpu-pallas" (same pipeline with the
-    fused pallas stationary-solve kernel, ops.pallas_queueing), or
-    "native" (the C++ solver in inferno_tpu.native, for controller
-    deployments without a TPU attachment). Returns the number of live lanes sized. Semantics match
-    the scalar path: infeasible lanes produce no candidate; zero-load
-    servers get the closed-form shortcut; every candidate's solver value
-    is the transition penalty from the server's current allocation.
+    fused pallas stationary-solve kernel, ops.pallas_queueing), "jax"
+    (the same jitted XLA kernel on whatever device jax has — the CPU
+    path for controller pods without a TPU attachment), or "native" (the
+    C++ solver in inferno_tpu.native). Returns the number of live lanes
+    sized. Semantics match the scalar path: infeasible lanes produce no
+    candidate; zero-load servers get the closed-form shortcut; every
+    candidate's solver value is the transition penalty from the server's
+    current allocation.
+
+    Candidates land as `LaneAllocations` — lazily materialized views of
+    the result arrays with a vectorized per-server best pick — so the
+    per-lane Python writeback loop of r01-r05 is gone: the unlimited
+    solver path constructs O(servers) Allocation objects per cycle, not
+    O(lanes).
     """
     if use_mesh and mesh is None:
         mesh = fleet_mesh()
@@ -536,31 +746,84 @@ def calculate_fleet(
             "tandem": tandem, "results": (result, tresult),
         }
 
-    def write_back(lanes, result, batch_of):
-        for i, (server_name, acc_name) in enumerate(lanes):
-            if not bool(result.feasible[i]):
-                continue
-            server = system.servers[server_name]
-            alloc = Allocation(
-                accelerator=acc_name,
-                num_replicas=int(result.num_replicas[i]),
-                batch_size=batch_of(i),
-                cost=float(result.cost[i]),
-                itl=float(result.itl[i]),
-                ttft=float(result.ttft[i]),
-                rho=float(result.rho[i]),
-                max_arrv_rate_per_replica=float(result.rate_star[i]) / 1000.0,
-            )
-            alloc.value = transition_penalty(server.cur_allocation, alloc)
-            server.all_allocations[acc_name] = alloc
+    # -- vectorized writeback: per-lane transition penalties, per-server
+    # candidate argmin, lazy Allocation views -------------------------------
+    names = list(system.servers)
+    acc_order = {a: i for i, a in enumerate(sorted(system.accelerators))}
+    n_srv = len(names)
+    cur_rank = np.full(n_srv, -1, np.int64)
+    cur_cost = np.zeros(n_srv, np.float64)
+    cur_reps = np.full(n_srv, -1, np.int64)
+    for i, server in enumerate(system.servers.values()):
+        cur = server.cur_allocation
+        if cur.accelerator:  # "" (no allocation) never equals a lane acc
+            cur_rank[i] = acc_order.get(cur.accelerator, -1)
+        cur_cost[i] = cur.cost
+        cur_reps[i] = cur.num_replicas
+
+    def lane_orders(p):
+        if p.server_idx is not None and p.acc_rank is not None:
+            return p.server_idx, p.acc_rank  # snapshot-packed, version-safe
+        # legacy-built plan (FLEET_SNAPSHOT=0): derive from the lane list
+        spos = {name: i for i, name in enumerate(names)}
+        return (
+            np.asarray([spos[s] for s, _ in p.lanes], np.int64),
+            np.asarray([acc_order[a] for _, a in p.lanes], np.int64),
+        )
 
     n = 0
+    src = _LaneSource()
+    cat: list[tuple[np.ndarray, ...]] = []  # (sidx, rank, value, cost, kind, lane)
+    kinds = []
     if plan is not None and result is not None:
-        write_back(plan.lanes, result, lambda i: int(plan.params.max_batch[i]))
+        kinds.append((0, plan, result, np.asarray(plan.params.max_batch)))
         n += plan.num_lanes
     if tandem is not None and tresult is not None:
-        write_back(
-            tandem.lanes, tresult, lambda i: int(tandem.params.decode_batch[i])
-        )
+        kinds.append((1, tandem, tresult, np.asarray(tandem.params.decode_batch)))
         n += tandem.num_lanes
+    for kind_id, p, res, batches in kinds:
+        sidx, rank = lane_orders(p)
+        cost64 = np.asarray(res.cost, np.float64)
+        reps = np.asarray(res.num_replicas, np.int64)
+        same_acc = rank == cur_rank[sidx]
+        ccost = cur_cost[sidx]
+        # transition_penalty(), elementwise in f64 with the scalar
+        # formula's exact operation order — the argmin below must agree
+        # bit-for-bit with the per-lane Python path it replaces
+        value = np.where(
+            same_acc & (reps == cur_reps[sidx]),
+            0.0,
+            np.where(
+                same_acc,
+                cost64 - ccost,
+                ACCEL_PENALTY_FACTOR * (ccost + cost64) + (cost64 - ccost),
+            ),
+        )
+        src.add(LaneAllocations._KIND[kind_id], p, res, value, batches)
+        fe = np.asarray(res.feasible, bool)
+        if fe.any():
+            cat.append((
+                sidx[fe], rank[fe], value[fe], cost64[fe],
+                np.full(int(fe.sum()), kind_id, np.int64), np.flatnonzero(fe),
+            ))
+    if not cat:
+        return n
+
+    sidx_all, rank_all, val_all, cost_all, kind_all, lane_all = (
+        np.concatenate(parts) for parts in zip(*cat)
+    )
+    # per-server segment-argmin with the deterministic tie-break
+    # (value, cost, accelerator rank) — mirrors solve_unlimited's scalar key
+    order = np.lexsort((rank_all, cost_all, val_all, sidx_all))
+    s_sorted = sidx_all[order]
+    starts = np.flatnonzero(np.r_[True, s_sorted[1:] != s_sorted[:-1]])
+    bounds = np.append(starts, len(s_sorted))
+    servers_list = list(system.servers.values())
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        picks = order[a:b]
+        sel = np.sort(picks)  # materialization order = packing order
+        servers_list[s_sorted[a]].all_allocations = LaneAllocations(
+            src, kind_all[sel], lane_all[sel],
+            (int(kind_all[picks[0]]), int(lane_all[picks[0]])),
+        )
     return n

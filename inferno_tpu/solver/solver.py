@@ -17,13 +17,28 @@ from inferno_tpu.solver.greedy import solve_greedy
 def solve_unlimited(system: System) -> None:
     """Unlimited chip capacity: each server independently takes its
     minimum-value (cheapest after transition penalty) candidate
-    (reference SolveUnlimited: pkg/solver/solver.go:63-79)."""
+    (reference SolveUnlimited: pkg/solver/solver.go:63-79).
+
+    Ties break deterministically by (value, cost, accelerator name) —
+    NOT dict insertion order — so the pick is bit-reproducible against
+    the vectorized per-server argmin `parallel.fleet.calculate_fleet`
+    precomputes. Candidates sized by the fleet path arrive as
+    `LaneAllocations` whose `best()` IS that argmin: consuming it keeps
+    the solve O(servers) with one materialized Allocation per server
+    instead of a Python scan over every lane."""
     for server in system.servers.values():
         server.remove_allocation()
-        best: Allocation | None = None
-        for alloc in server.all_allocations.values():
-            if best is None or alloc.value < best.value:
-                best = alloc
+        allocs = server.all_allocations
+        picker = getattr(allocs, "best", None)
+        if picker is not None:
+            best = picker()
+        else:
+            best: Allocation | None = None
+            for alloc in allocs.values():
+                if best is None or (alloc.value, alloc.cost, alloc.accelerator) < (
+                    best.value, best.cost, best.accelerator
+                ):
+                    best = alloc
         if best is not None:
             server.set_allocation(best)
 

@@ -7,6 +7,13 @@ exposition callables MiniProm can scrape for those variants, and a
 FakeProm that answers the coalesced collector's grouped query shapes
 from a static per-variant table (for bit-exact parity tests where
 MiniProm's walking clock would blur comparisons).
+
+`fleet_system_spec` builds the SOLVE-LAYER equivalent: an N-variant
+SystemSpec (no cluster, no Prometheus) spanning the sizing edge lanes —
+aggregated and tandem (disagg) shapes, zero-load variants, pinned
+(keep_accelerator) variants, infeasible SLO targets — shared by the
+scalar<->vectorized parity suite (tests/test_vectorized_sizing.py) and
+the `bench.py --sizing` scaling benchmark.
 """
 
 from __future__ import annotations
@@ -27,6 +34,122 @@ from inferno_tpu.controller.kube import InMemoryCluster
 CONFIG_NS = "inferno-system"
 FLEET_NS = "fleet"
 SERVICE_CLASS = "Premium"
+
+# the sizing-spec slice-shape catalog: (shape, cents per chip-hour)
+SIZING_SHAPES = (("v5e-4", 10.0), ("v5e-8", 12.0), ("v5e-16", 10.0))
+
+
+def fleet_system_spec(
+    n_variants: int,
+    shapes_per_variant: int = 2,
+    tandem_every: int = 7,
+    zero_load_every: int = 11,
+    pinned_every: int = 5,
+    infeasible_every: int = 13,
+    seed: int = 0,
+):
+    """An N-variant SystemSpec exercising every sizing edge lane.
+
+    Each variant serves its own model (distinct profiles, so the
+    columnar snapshot tracks N independent structures) on
+    `shapes_per_variant` candidate slice shapes. Deterministic in
+    `seed`; the periodic knobs fold in the edge cases (`0` disables
+    one): every `tandem_every`-th variant's profiles are disaggregated
+    (prefill/decode tandem units), every `zero_load_every`-th variant
+    has zero arrival (the closed-form shortcut path), every
+    `pinned_every`-th variant pins candidates to its current shape
+    (`keep_accelerator`), and every `infeasible_every`-th variant gets
+    an unmeetable ITL target (no feasible lane on any shape).
+    """
+    import numpy as np
+
+    from inferno_tpu.config import (
+        AcceleratorSpec,
+        AllocationData,
+        CapacitySpec,
+        DecodeParms,
+        DisaggSpec,
+        ModelPerfSpec,
+        ModelTarget,
+        OptimizerSpec,
+        PrefillParms,
+        ServerLoadSpec,
+        ServerSpec,
+        ServiceClassSpec,
+        SystemSpec,
+    )
+
+    rng = np.random.default_rng(seed)
+    shapes = SIZING_SHAPES[: max(shapes_per_variant, 1)]
+    accelerators = [
+        AcceleratorSpec(name=name, cost_per_chip_hr=cost) for name, cost in shapes
+    ]
+    models, targets, servers = [], [], []
+    for i in range(n_variants):
+        model = fleet_model(i)
+        tandem = tandem_every and i % tandem_every == tandem_every - 1
+        size = float(rng.uniform(0.8, 2.5))
+        for s, (shape, _) in enumerate(shapes):
+            speed = (s + 1) ** 0.5
+            models.append(ModelPerfSpec(
+                name=model, acc=shape,
+                max_batch_size=max(8, int(48 / size) * (s + 1)),
+                at_tokens=128,
+                decode_parms=DecodeParms(
+                    alpha=10.0 * size / speed + 4.0, beta=0.25 * size / speed,
+                ),
+                prefill_parms=PrefillParms(
+                    gamma=3.0 * size / speed + 1.0, delta=0.015 * size / speed,
+                ),
+                disagg=(
+                    DisaggSpec(prefill_slices=1, decode_slices=2,
+                               prefill_max_batch=8)
+                    if tandem else None
+                ),
+            ))
+        infeasible = infeasible_every and i % infeasible_every == infeasible_every - 1
+        targets.append(ModelTarget(
+            model=model,
+            slo_itl=0.001 if infeasible else 60.0,
+            slo_ttft=1.0 if infeasible else 1500.0,
+        ))
+        zero = zero_load_every and i % zero_load_every == zero_load_every - 1
+        pinned = pinned_every and i % pinned_every == pinned_every - 1
+        cur = AllocationData(
+            accelerator=shapes[0][0], num_replicas=1 + i % 3,
+        )
+        cur.load = ServerLoadSpec(
+            arrival_rate=0.0 if zero else float(rng.uniform(30.0, 900.0)),
+            avg_in_tokens=float(rng.integers(32, 512)),
+            avg_out_tokens=float(rng.integers(16, 384)),
+        )
+        servers.append(ServerSpec(
+            name=f"{FLEET_NS}/{fleet_variant(i)}",
+            class_name=SERVICE_CLASS,
+            model=model,
+            keep_accelerator=bool(pinned),
+            min_num_replicas=1,
+            current_alloc=cur,
+        ))
+    return SystemSpec(
+        accelerators=accelerators,
+        models=models,
+        service_classes=[ServiceClassSpec(
+            name=SERVICE_CLASS, priority=1, model_targets=targets,
+        )],
+        servers=servers,
+        optimizer=OptimizerSpec(unlimited=True),
+        capacity=CapacitySpec(chips={}),
+    )
+
+
+def perturb_loads(system, scale: float = 1.02) -> None:
+    """Scale every loaded server's arrival rate in place — the cheapest
+    'every variant changed' cycle input (defeats plan replay so repeated
+    sizing passes measure honest recompute, as a live fleet would)."""
+    for server in system.servers.values():
+        if server.load is not None and server.load.arrival_rate > 0:
+            server.load.arrival_rate *= scale
 
 
 def fleet_model(i: int) -> str:

@@ -402,8 +402,10 @@ def test_run_forever_soak_with_gate_flaps_and_pokes():
 
 class TestAutoBackend:
     """compute_backend="auto" (the default) resolves at Reconciler init:
-    tpu if a device is attached, else native, else scalar — and the
-    resolution is logged (round-3 verdict weak #2)."""
+    tpu if a device is attached, else native, else the jitted XLA kernel
+    on CPU ("jax") — every resolution is a BATCHED backend (ISSUE-6:
+    the per-variant scalar loop is a parity oracle, never auto-selected)
+    and the resolution is logged (round-3 verdict weak #2)."""
 
     def _rec(self, monkeypatch, tpu_present, native_ok):
         from inferno_tpu import native as native_mod
@@ -426,9 +428,9 @@ class TestAutoBackend:
         rec = self._rec(monkeypatch, tpu_present=False, native_ok=True)
         assert rec.config.compute_backend == "native"
 
-    def test_scalar_last_resort(self, monkeypatch):
+    def test_jax_last_resort_never_scalar(self, monkeypatch):
         rec = self._rec(monkeypatch, tpu_present=False, native_ok=False)
-        assert rec.config.compute_backend == "scalar"
+        assert rec.config.compute_backend == "jax"
 
     def test_explicit_backend_not_overridden(self, monkeypatch):
         from inferno_tpu.controller import reconciler as rmod
