@@ -6,8 +6,8 @@ IMG ?= inferno-tpu-autoscaler:latest
 CLUSTER ?= inferno-tpu
 
 .PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
-        bench-sizing native lint lint-metrics manifests-sync docker-build \
-        deploy-kind deploy undeploy clean
+        bench-sizing bench-capacity native lint lint-metrics manifests-sync \
+        docker-build deploy-kind deploy undeploy clean
 
 all: native test
 
@@ -42,6 +42,12 @@ bench:
 # 200 -> 10k synthetic variants, curve recorded in bench_full.json
 bench-sizing:
 	$(PYTHON) bench.py --sizing
+
+# Capacity-constrained solve benchmark (ISSUE-7): 10k variants under
+# shared chip pools at 100/80/50% capacity vs the unconstrained pass,
+# with graceful-degradation counts; recorded in bench_full.json
+bench-capacity:
+	$(PYTHON) bench.py --capacity
 
 # Synthetic 200-variant reconcile-cycle benchmark: serial per-variant
 # collection vs coalesced queries + concurrency + sizing cache

@@ -77,6 +77,10 @@ class AcceleratorSpec:
     name: str  # slice shape name, e.g. "v5e-16"
     pool: str = ""  # capacity pool / generation; default from name
     chips: int = 0  # chips per slice; default from catalog
+    # placement region/zone ("" = unregioned): allocations on this shape
+    # additionally draw from any matching "pool/region" quota bucket
+    # (CapacitySpec.quotas) when one is configured
+    region: str = ""
     mem_per_chip_gb: float = 16.0  # HBM per chip
     mem_bw_gbs: float = 820.0  # HBM bandwidth per chip
     cost_per_chip_hr: float = 0.0  # cents per chip-hour
@@ -107,6 +111,7 @@ class AcceleratorSpec:
             "name": self.name,
             "pool": self.pool,
             "chips": self.chips,
+            "region": self.region,
             "memPerChipGB": self.mem_per_chip_gb,
             "memBWGBs": self.mem_bw_gbs,
             "costPerChipHr": self.cost_per_chip_hr,
@@ -119,6 +124,7 @@ class AcceleratorSpec:
             name=d["name"],
             pool=_get(d, "pool", "type", default=""),
             chips=int(_get(d, "chips", "multiplicity", default=0) or 0),
+            region=str(d.get("region", "") or ""),
             mem_per_chip_gb=float(_get(d, "memPerChipGB", "memSize", default=16.0)),
             mem_bw_gbs=float(_get(d, "memBWGBs", "memBW", default=820.0)),
             cost_per_chip_hr=float(_get(d, "costPerChipHr", "cost", default=0.0)),
@@ -527,20 +533,36 @@ class CapacitySpec:
     TPU analogue of the reference's per-type card counts
     (pkg/config/types.go:48-56): the unit here is a *chip*, and allocations
     consume chips in whole-slice (hence whole-host) quanta.
+
+    `quotas` layers sub-budgets on top of the pool totals: a key is either
+    a bare pool name (a pool-wide cap tighter than discovered inventory)
+    or "pool/region" (a per-region carve-out matched against
+    `AcceleratorSpec.region`). An allocation must fit its pool budget AND
+    every matching quota bucket; a pool or quota absent from `chips` /
+    `quotas` respectively means zero capacity / no extra constraint.
     """
 
     chips: dict[str, int] = dataclasses.field(default_factory=dict)
+    quotas: dict[str, int] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict[str, Any]:
-        return {"chips": dict(self.chips)}
+        out: dict[str, Any] = {"chips": dict(self.chips)}
+        if self.quotas:
+            out["quotas"] = dict(self.quotas)
+        return out
 
     @classmethod
     def from_dict(cls, d: Mapping[str, Any]) -> "CapacitySpec":
+        quotas = {k: int(v) for k, v in (d.get("quotas", {}) or {}).items()}
         if "chips" in d:
-            return cls(chips={k: int(v) for k, v in d["chips"].items()})
+            return cls(
+                chips={k: int(v) for k, v in d["chips"].items()}, quotas=quotas
+            )
         # reference shape: {"count": [{"type": ..., "count": ...}]}
         counts = d.get("count", []) or []
-        return cls(chips={c["type"]: int(c["count"]) for c in counts})
+        return cls(
+            chips={c["type"]: int(c["count"]) for c in counts}, quotas=quotas
+        )
 
 
 @dataclasses.dataclass

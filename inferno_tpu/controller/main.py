@@ -57,6 +57,11 @@ controller.go:516-582):
                                 inputs are unchanged since last cycle
   SIZING_CACHE_TOLERANCE        relative arrival-rate tolerance for sizing-
                                 cache hits (default 0.02 = 2%)
+  GREEDY_VECTORIZED             true|false (default true): limited-mode
+                                solve over the columnar fleet candidate
+                                table; 0 forces the scalar reference
+                                implementation (bit-identical results;
+                                docs/performance.md)
   PROMETHEUS_QUERY_TIMEOUT      per-query timeout in seconds (default 30)
 """
 

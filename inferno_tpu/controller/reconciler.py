@@ -482,6 +482,9 @@ class Reconciler:
                     name=name,
                     cost_per_chip_hr=float(obj.get("cost", 0.0) or 0.0),
                     mem_per_chip_gb=float(obj.get("memPerChipGB", 16.0) or 16.0),
+                    # placement region: selects the "pool/region" quota
+                    # bucket (TPU_POOL_QUOTAS) this shape draws from
+                    region=str(obj.get("region", "") or ""),
                 )
             )
         return out
@@ -533,14 +536,28 @@ class Reconciler:
                 )
             except (json.JSONDecodeError, ValueError, AttributeError):
                 pass
+        # per-pool[/region] quota carve-outs layered on the pool budgets
+        # ({"v5e": 256, "v5e/us-east1": 64}); malformed JSON is ignored
+        # like TPU_CAPACITY — a ConfigMap typo must not abort the cycle
+        raw_quotas = data.get("TPU_POOL_QUOTAS", "")
+        if raw_quotas:
+            try:
+                capacity.quotas = {
+                    k: int(v) for k, v in json.loads(raw_quotas).items()
+                }
+            except (json.JSONDecodeError, ValueError, AttributeError):
+                pass
         if not optimizer.unlimited and not capacity.chips:
             # limited mode with no static capacity: discover chip pools from
             # node google.com/tpu resources (inventory.py); an inventory
             # failure leaves capacity empty, and the greedy solver then has
             # nothing to assign — safer than inventing capacity, but it must
-            # be visible in the logs
+            # be visible in the logs. Configured quotas survive discovery
+            # (they carve the discovered budgets, not replace them).
             try:
-                capacity = collect_tpu_inventory(self.kube)
+                capacity = dataclasses.replace(
+                    collect_tpu_inventory(self.kube), quotas=capacity.quotas
+                )
             except (KubeError, OSError):
                 # OSError: connection-level failures (URLError) bypass the
                 # HTTP error mapping in the REST client
@@ -1458,12 +1475,25 @@ class Reconciler:
                 "no feasible allocation (SLO unachievable or capacity exhausted)",
             )
             if rec is not None:
-                rec.decide(
-                    REASON_CAPACITY_LIMITED,
-                    replicas=floor,
-                    detail="no feasible allocation "
-                           "(SLO unachievable or capacity exhausted)",
+                detail = (
+                    "no feasible allocation "
+                    "(SLO unachievable or capacity exhausted)"
                 )
+                degr = (
+                    getattr(system, "degradations", {}).get(va.full_name)
+                    if system is not None
+                    else None
+                )
+                if degr is not None:
+                    rec.degradation_step = degr.step
+                    rec.chip_shortfall = degr.shortfall_chips
+                    detail = (
+                        f"zeroed by capacity: preferred "
+                        f"{degr.from_accelerator} x{degr.from_replicas} "
+                        f"short {degr.shortfall_chips} chips in pool "
+                        f"{degr.pool}"
+                    )
+                rec.decide(REASON_CAPACITY_LIMITED, replicas=floor, detail=detail)
         try:
             self.actuator.emit_metrics(fresh)
             fresh.status.actuation_applied = True
@@ -1494,6 +1524,37 @@ class Reconciler:
         server = system.servers.get(server_name) if system is not None else None
         chosen = server.allocation if server is not None else None
         min_replicas = server.min_num_replicas if server is not None else 1
+        # capacity degradation (limited mode): the solver stepped this
+        # variant down the graceful-degradation ladder — that IS the
+        # decision, whatever the replica arithmetic below would say
+        degr = (
+            getattr(system, "degradations", {}).get(server_name)
+            if system is not None
+            else None
+        )
+        if degr is not None:
+            rec.degradation_step = degr.step
+            rec.chip_shortfall = degr.shortfall_chips
+            rec.decide(
+                REASON_CAPACITY_LIMITED,
+                accelerator=alloc.accelerator,
+                replicas=alloc.num_replicas,
+                detail=(
+                    f"capacity degradation ({degr.step}): preferred "
+                    f"{degr.from_accelerator} x{degr.from_replicas} short "
+                    f"{degr.shortfall_chips} chips in pool {degr.pool}; "
+                    f"allocated {alloc.accelerator} x{alloc.num_replicas}"
+                ),
+            )
+            rec.ttft_predicted_ms = alloc.ttft_average
+            rec.itl_predicted_ms = alloc.itl_average
+            rec.ttft_headroom_ms = rec.slo_ttft_ms - alloc.ttft_average
+            rec.itl_headroom_ms = rec.slo_itl_ms - alloc.itl_average
+            rec.cost = alloc.cost
+            rec.cost_delta = alloc.cost - rec.prev_cost
+            if chosen is not None:
+                rec.lambda_max_rpm = chosen.max_rpm
+            return
         # forecast_bound: the forecast upper band (not the observed λ)
         # was the binding sizing input — observed load alone would have
         # needed strictly fewer replicas at the chosen λ_max ceiling

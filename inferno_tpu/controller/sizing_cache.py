@@ -50,16 +50,20 @@ def _perf_key(perf) -> tuple:
 
 
 def system_fingerprint(system) -> tuple:
-    """The cycle-global signature component: the accelerator catalog and
-    chip capacity. Candidate sizing is per-lane and does not read
-    capacity, but a capacity or catalog change is exactly the moment an
-    operator expects every cached decision to be re-derived."""
+    """The cycle-global signature component: the accelerator catalog
+    (incl. placement regions) and the chip capacity AND quota state.
+    Candidate sizing is per-lane and does not read capacity, but a
+    capacity, quota, or catalog change is exactly the moment an operator
+    expects every cached decision to be re-derived — the limited-mode
+    solve consumes the cached candidates, so a quota edit must not
+    replay sizings whose solve context changed."""
     return (
         tuple(
-            (a.name, a.pool, a.chips, a.cost)
+            (a.name, a.pool, a.chips, a.cost, a.region)
             for a in sorted(system.accelerators.values(), key=lambda a: a.name)
         ),
         tuple(sorted(system.capacity.items())),
+        tuple(sorted(getattr(system, "quotas", {}).items())),
     )
 
 

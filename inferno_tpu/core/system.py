@@ -53,6 +53,13 @@ class Accelerator:
         return self.spec.pool
 
     @property
+    def region(self) -> str:
+        """Placement region ("" = unregioned); selects the "pool/region"
+        quota bucket this shape additionally draws from, when one is
+        configured on System.quotas."""
+        return self.spec.region
+
+    @property
     def chips(self) -> int:
         return self.spec.chips
 
@@ -221,11 +228,22 @@ class System:
         self.service_classes: dict[str, ServiceClass] = {}
         self.servers: dict[str, Server] = {}
         self.capacity: dict[str, int] = {}  # available chips per pool
+        # sub-budgets layered on the pool totals: "pool" (pool-wide cap)
+        # or "pool/region" (per-region carve-out) -> chips. An allocation
+        # must fit its pool budget AND every matching quota bucket.
+        self.quotas: dict[str, int] = {}
         self.pool_usage: dict[str, PoolUsage] = {}
         # set by calculate_all / parallel.calculate_fleet; lets the
         # optimizer's auto mode distinguish "never sized" from "sized and
         # found infeasible" (empty all_allocations in both cases)
         self.candidates_calculated = False
+        # columnar candidate table attached by parallel.calculate_fleet
+        # (parallel/fleet.FleetCandidates) — the capacity-constrained
+        # solver's vectorized input; None when sizing ran scalar
+        self.fleet_candidates = None
+        # per-server capacity degradation emitted by the limited-mode
+        # solve: server name -> solver.greedy.DegradationEvent
+        self.degradations: dict = {}
         if spec is not None:
             self.set_from_spec(spec)
 
@@ -241,6 +259,7 @@ class System:
         for server_spec in spec.servers:
             self.servers[server_spec.name] = Server(server_spec)
         self.capacity.update(spec.capacity.chips)
+        self.quotas.update(spec.capacity.quotas)
 
     # -- solve support ------------------------------------------------------
 

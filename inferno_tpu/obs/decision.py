@@ -92,6 +92,14 @@ class DecisionRecord:
     # "solved" | "cached" — cached means the candidate allocations were
     # replayed from the sizing cache (inputs unchanged within tolerance)
     sizing_provenance: str = SIZING_PROVENANCE_SOLVED
+    # capacity degradation (limited mode, solver/greedy.py ladder): which
+    # rung this variant landed on ("" = none) — "shape" (value-worse
+    # slice shape), "int8" (stepped onto a quantized -int8 catalog
+    # entry), "replicas" (best-effort scaled below the SLO count),
+    # "zeroed" (nothing fit) — and the chip deficit of its preferred
+    # candidate in the binding pool/quota bucket
+    degradation_step: str = ""
+    chip_shortfall: int = 0
     accelerator: str = ""
     replicas: int = 0
     prev_accelerator: str = ""

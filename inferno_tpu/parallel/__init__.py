@@ -1,4 +1,5 @@
 from inferno_tpu.parallel.fleet import (
+    FleetCandidates,
     FleetPlan,
     LaneAllocations,
     TandemPlan,
@@ -12,6 +13,7 @@ from inferno_tpu.parallel.fleet import (
 from inferno_tpu.parallel.mesh import fleet_mesh, shard_fleet_params
 
 __all__ = [
+    "FleetCandidates",
     "FleetPlan",
     "LaneAllocations",
     "TandemPlan",

@@ -46,18 +46,21 @@ _K_PAD = 128  # head (max-batch) grid padded to this floor (fewer recompiles;
 class FleetPlan:
     """A flattened fleet batch plus the lane -> (server, acc) mapping.
 
-    `server_idx`/`acc_rank` (set by the snapshot packer) feed the
-    vectorized per-server candidate argmin in `calculate_fleet`: lane ->
-    position in the system's server order, and lane accelerator ->
-    sorted-catalog rank (the deterministic tie-break axis). Legacy-built
-    plans leave them None and `calculate_fleet` derives both from
-    `lanes` — the arrays are only valid for the system they were built
-    against, which the snapshot's version key guarantees."""
+    `server_idx`/`acc_rank`/`chips_per_replica` (set by the snapshot
+    packer) feed the vectorized per-server candidate argmin and the
+    capacity-constrained solver in `calculate_fleet`: lane -> position
+    in the system's server order, lane accelerator -> sorted-catalog
+    rank (the deterministic tie-break axis), and lane -> whole-slice
+    chip demand per replica. Legacy-built plans leave them None and
+    `calculate_fleet` derives all three from `lanes` — the arrays are
+    only valid for the system they were built against, which the
+    snapshot's version key guarantees."""
 
     params: FleetParams
     lanes: list[tuple[str, str]]  # (server_name, acc_name) per lane
     server_idx: np.ndarray | None = None
     acc_rank: np.ndarray | None = None
+    chips_per_replica: np.ndarray | None = None
 
     @property
     def num_lanes(self) -> int:
@@ -72,6 +75,7 @@ class TandemPlan:
     lanes: list[tuple[str, str]]  # (server_name, acc_name) per lane
     server_idx: np.ndarray | None = None
     acc_rank: np.ndarray | None = None
+    chips_per_replica: np.ndarray | None = None
 
     @property
     def num_lanes(self) -> int:
@@ -211,13 +215,14 @@ def _snapshot_plan(system: System, only: set[str] | None, kind: str):
         if not lanes:
             return None
         cols = snap.columns(kind, rows)
-        server_idx, acc_rank = snap.meta(kind, rows)
+        server_idx, acc_rank, chips = snap.meta(kind, rows)
         cls, pcls = (
             (FleetPlan, FleetParams) if kind == "agg" else (TandemPlan, TandemParams)
         )
         return cls(
             params=pcls(**cols), lanes=lanes,
             server_idx=server_idx, acc_rank=acc_rank,
+            chips_per_replica=chips,
         )
 
     return _memoized_plan(f"snap-{kind}", key, build)
@@ -540,15 +545,20 @@ _solve_memo: dict = {}
 class _LaneSource:
     """Per-cycle context the lazy allocations materialize from: the solved
     plans/results plus the vectorized f64 transition-penalty values (bit
-    identical to scalar `transition_penalty` on the same f32 results)."""
+    identical to scalar `transition_penalty` on the same f32 results).
 
-    __slots__ = ("plans", "results", "values", "batches")
+    `materialized` counts Allocation objects actually constructed — the
+    lazy-materialization counter the capacity-solver tests assert on (a
+    constrained solve must stay O(servers), never inflate O(lanes))."""
+
+    __slots__ = ("plans", "results", "values", "batches", "materialized")
 
     def __init__(self):
         self.plans: dict[str, object] = {}
         self.results: dict[str, object] = {}
         self.values: dict[str, np.ndarray] = {}
         self.batches: dict[str, np.ndarray] = {}
+        self.materialized = 0
 
     def add(self, kind, plan, result, values, batches) -> None:
         self.plans[kind] = plan
@@ -557,6 +567,7 @@ class _LaneSource:
         self.batches[kind] = batches
 
     def materialize(self, kind: str, lane: int) -> Allocation:
+        self.materialized += 1
         res = self.results[kind]
         _, acc = self.plans[kind].lanes[lane]
         alloc = Allocation(
@@ -615,19 +626,27 @@ class LaneAllocations(dict):
         if self._best is None:
             return None
         if self._src is not None:
-            kind_id, lane = self._best
-            kind = self._KIND[kind_id]
-            acc = self._src.plans[kind].lanes[int(lane)][1]
-            if not dict.__contains__(self, acc):  # raw check: stay lazy
-                alloc = self._src.materialize(kind, int(lane))
-                dict.__setitem__(self, alloc.accelerator, alloc)
-                return alloc
-            return dict.__getitem__(self, acc)
+            return self.lane_alloc(*self._best)
         return min(
             dict.values(self),
             key=lambda a: (a.value, a.cost, a.accelerator),
             default=None,
         )
+
+    def lane_alloc(self, kind_id: int, lane: int) -> Allocation:
+        """Materialize ONE specific lane (a capacity-solver winner) into
+        the view's raw storage without inflating the rest — the greedy
+        analogue of `best()`, keeping object identity for later dict
+        access. Only valid while the lazy source is still attached."""
+        if self._src is None:
+            raise RuntimeError("lane_alloc on a materialized LaneAllocations")
+        kind = self._KIND[kind_id]
+        acc = self._src.plans[kind].lanes[int(lane)][1]
+        if not dict.__contains__(self, acc):  # raw check: stay lazy
+            alloc = self._src.materialize(kind, int(lane))
+            dict.__setitem__(self, alloc.accelerator, alloc)
+            return alloc
+        return dict.__getitem__(self, acc)
 
     def __reduce__(self):  # copy/pickle: materialize into a plain dict
         self._ensure()
@@ -651,6 +670,37 @@ for _name in (
 ):
     setattr(LaneAllocations, _name, _lazy(_name))
 del _name
+
+
+@dataclasses.dataclass
+class FleetCandidates:
+    """Columnar per-server candidate table for the capacity-constrained
+    solver (`solver.greedy_vec`): every FEASIBLE lane of this cycle's
+    solve, sorted per server by the deterministic candidate key
+    (value, cost, accelerator rank) — the exact order the scalar greedy
+    walks. Rows reference the lazy `_LaneSource`, so the solver assigns
+    winners by materializing ONE Allocation per allocated server
+    (`LaneAllocations.lane_alloc`), never inflating candidate dicts.
+
+    Attached to `System.fleet_candidates` by `calculate_fleet`; arrays
+    are only valid against the System they were built for (the System is
+    a per-cycle value)."""
+
+    src: _LaneSource
+    server: np.ndarray  # server position (system order) per sorted row
+    kind: np.ndarray  # 0=agg, 1=tan per sorted row
+    lane: np.ndarray  # lane index into that kind's plan
+    value: np.ndarray  # f64 transition penalty (the solver objective)
+    cost: np.ndarray  # f64
+    reps: np.ndarray  # int64 SLO-satisfying replica count
+    chips: np.ndarray  # int64 chips per replica (slices x slice.chips)
+    rank: np.ndarray  # int64 accelerator rank in the sorted catalog
+    bounds: np.ndarray  # per-server segment boundaries into the rows
+    seg_server: np.ndarray  # server position per segment
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.server)
 
 
 def calculate_fleet(
@@ -681,6 +731,10 @@ def calculate_fleet(
     """
     if use_mesh and mesh is None:
         mesh = fleet_mesh()
+
+    # the candidate table is rebuilt (or cleared) every call — a stale
+    # table must never describe lanes of a previous solve
+    system.fleet_candidates = None
 
     for name, server in system.servers.items():
         if only is not None and name not in only:
@@ -762,18 +816,31 @@ def calculate_fleet(
         cur_reps[i] = cur.num_replicas
 
     def lane_orders(p):
-        if p.server_idx is not None and p.acc_rank is not None:
-            return p.server_idx, p.acc_rank  # snapshot-packed, version-safe
+        if (
+            p.server_idx is not None
+            and p.acc_rank is not None
+            and p.chips_per_replica is not None
+        ):
+            # snapshot-packed, version-safe
+            return p.server_idx, p.acc_rank, p.chips_per_replica
         # legacy-built plan (FLEET_SNAPSHOT=0): derive from the lane list
         spos = {name: i for i, name in enumerate(names)}
+        chips = np.empty(len(p.lanes), np.int64)
+        for i, (s, a) in enumerate(p.lanes):
+            model = system.models.get(system.servers[s].model_name)
+            chips[i] = (
+                model.slices_per_replica(a) * system.accelerators[a].chips
+            )
         return (
             np.asarray([spos[s] for s, _ in p.lanes], np.int64),
             np.asarray([acc_order[a] for _, a in p.lanes], np.int64),
+            chips,
         )
 
     n = 0
     src = _LaneSource()
-    cat: list[tuple[np.ndarray, ...]] = []  # (sidx, rank, value, cost, kind, lane)
+    # (sidx, rank, value, cost, reps, chips, kind, lane) per feasible lane
+    cat: list[tuple[np.ndarray, ...]] = []
     kinds = []
     if plan is not None and result is not None:
         kinds.append((0, plan, result, np.asarray(plan.params.max_batch)))
@@ -782,7 +849,7 @@ def calculate_fleet(
         kinds.append((1, tandem, tresult, np.asarray(tandem.params.decode_batch)))
         n += tandem.num_lanes
     for kind_id, p, res, batches in kinds:
-        sidx, rank = lane_orders(p)
+        sidx, rank, chips = lane_orders(p)
         cost64 = np.asarray(res.cost, np.float64)
         reps = np.asarray(res.num_replicas, np.int64)
         same_acc = rank == cur_rank[sidx]
@@ -804,14 +871,16 @@ def calculate_fleet(
         if fe.any():
             cat.append((
                 sidx[fe], rank[fe], value[fe], cost64[fe],
+                reps[fe], np.asarray(chips, np.int64)[fe],
                 np.full(int(fe.sum()), kind_id, np.int64), np.flatnonzero(fe),
             ))
     if not cat:
         return n
 
-    sidx_all, rank_all, val_all, cost_all, kind_all, lane_all = (
-        np.concatenate(parts) for parts in zip(*cat)
-    )
+    (
+        sidx_all, rank_all, val_all, cost_all,
+        reps_all, chips_all, kind_all, lane_all,
+    ) = (np.concatenate(parts) for parts in zip(*cat))
     # per-server segment-argmin with the deterministic tie-break
     # (value, cost, accelerator rank) — mirrors solve_unlimited's scalar key
     order = np.lexsort((rank_all, cost_all, val_all, sidx_all))
@@ -826,4 +895,19 @@ def calculate_fleet(
             src, kind_all[sel], lane_all[sel],
             (int(kind_all[picks[0]]), int(lane_all[picks[0]])),
         )
+    # the capacity-constrained solver's columnar input: the same sorted
+    # segments the argmin above consumed, one row per feasible lane
+    system.fleet_candidates = FleetCandidates(
+        src=src,
+        server=s_sorted,
+        kind=kind_all[order],
+        lane=lane_all[order],
+        value=val_all[order],
+        cost=cost_all[order],
+        reps=reps_all[order],
+        chips=chips_all[order],
+        rank=rank_all[order],
+        bounds=bounds,
+        seg_server=s_sorted[starts],
+    )
     return n

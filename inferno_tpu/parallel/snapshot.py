@@ -41,12 +41,15 @@ from inferno_tpu.config.defaults import MAX_QUEUE_TO_BATCH_RATIO
 
 # structural static columns shared by both lane kinds ("acc_rank" is the
 # lane accelerator's position in the sorted catalog — the deterministic
-# tie-break axis of the vectorized candidate argmin, not a solver input)
+# tie-break axis of the vectorized candidate argmin, not a solver input;
+# "chips_per_replica" feeds the capacity-constrained solver's per-pool
+# chip demand, slices_per_replica x slice.chips)
 _SHARED_STATIC = (
     "alpha", "beta", "gamma", "delta",
     "target_ttft", "target_itl", "target_tps",
     "min_replicas", "cost_per_replica",
     "perf_max_batch", "at_tokens", "server_max_batch", "acc_rank",
+    "chips_per_replica",
 )
 # tandem-only statics (disagg unit shape; validity of the spec itself)
 _TAN_STATIC = ("dg_prefill_max_batch", "prefill_slices", "decode_slices")
@@ -207,6 +210,9 @@ class FleetSnapshot:
             frag["at_tokens"].append(perf.at_tokens)
             frag["server_max_batch"].append(server.max_batch_size)
             frag["acc_rank"].append(acc_rank[acc.name])
+            frag["chips_per_replica"].append(
+                model.slices_per_replica(acc.name) * acc.chips
+            )
             if kind is self._tan:
                 dg = perf.disagg
                 frag["dg_prefill_max_batch"].append(dg.prefill_max_batch)
@@ -217,9 +223,14 @@ class FleetSnapshot:
     def _global_fingerprint(self, system) -> tuple:
         # catalog membership/order/cost and class targets are consumed by
         # every server's walk; model profiles are fingerprinted
-        # per-server (so a corrected model re-derives only its servers)
+        # per-server (so a corrected model re-derives only its servers).
+        # pool/chips/region ride along because the chips_per_replica
+        # column (the capacity solver's demand axis) depends on them
         return (
-            tuple((a.name, a.cost) for a in system.accelerators.values()),
+            tuple(
+                (a.name, a.cost, a.pool, a.chips, a.region)
+                for a in system.accelerators.values()
+            ),
             tuple(
                 (s.name, tuple(
                     (t.model, t.slo_ttft, t.slo_itl, t.slo_tps)
@@ -392,15 +403,20 @@ class FleetSnapshot:
         rows = rows[kind.mask[rows]] if len(rows) else rows
         return rows, [kind.lanes[i] for i in rows]
 
-    def meta(self, kind_name: str, rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        """(server_idx, acc_rank) for the selected rows: server_idx maps
-        each lane to its position in the system's server order, acc_rank
-        is the lane accelerator's sorted-catalog rank — the inputs of the
-        vectorized per-server candidate argmin in parallel.fleet."""
+    def meta(
+        self, kind_name: str, rows: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(server_idx, acc_rank, chips_per_replica) for the selected
+        rows: server_idx maps each lane to its position in the system's
+        server order, acc_rank is the lane accelerator's sorted-catalog
+        rank, chips_per_replica its whole-slice chip demand — the inputs
+        of the vectorized per-server candidate argmin and the
+        capacity-constrained solver in parallel.fleet."""
         kind = self._agg if kind_name == "agg" else self._tan
         return (
             kind.lane_server[rows],
             kind.cols["acc_rank"][rows].astype(np.int64),
+            kind.cols["chips_per_replica"][rows].astype(np.int64),
         )
 
     def columns(self, kind_name: str, rows: np.ndarray) -> dict[str, np.ndarray]:

@@ -1,5 +1,17 @@
-from inferno_tpu.solver.greedy import solve_greedy
+from inferno_tpu.solver.greedy import (
+    DegradationEvent,
+    solve_greedy,
+)
+from inferno_tpu.solver.greedy_vec import solve_greedy_fleet
 from inferno_tpu.solver.solver import Solver, solve_unlimited
 from inferno_tpu.solver.optimizer import Optimizer, optimize
 
-__all__ = ["Solver", "solve_unlimited", "solve_greedy", "Optimizer", "optimize"]
+__all__ = [
+    "Solver",
+    "solve_unlimited",
+    "solve_greedy",
+    "solve_greedy_fleet",
+    "DegradationEvent",
+    "Optimizer",
+    "optimize",
+]

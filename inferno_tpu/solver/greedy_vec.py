@@ -1,0 +1,450 @@
+"""Vectorized capacity-constrained greedy solve over shared chip pools.
+
+`solve_greedy_fleet` is the fleet-scale implementation of the limited
+mode: it consumes the columnar candidate table attached to the System by
+`parallel.fleet.calculate_fleet` (`FleetCandidates` — every feasible
+lane, pre-sorted per server by the deterministic (value, cost,
+accelerator-rank) key) and solves priority groups as vectorized buckets:
+
+* the common case — the whole priority group's preferred-candidate chip
+  demand fits the remaining pools and quotas — is ONE numpy bincount
+  check followed by a bulk allocation, no per-server Python beyond
+  materializing each winner;
+* only when a pool binds does the group fall into the exact sequential
+  loop, driven by a heap over (priority, -regret, -value) keys with
+  tie-sequencing replicating the scalar solver's bisect_left reinsertion
+  semantics. Each step is O(log n) array indexing — no Allocation
+  objects, no candidate dicts.
+
+The lazy `LaneAllocations.best()`/`lane_alloc()` path stays lazy end to
+end: an allocated server materializes exactly ONE Allocation (its
+winner); full candidate sets inflate only for the (rare) servers that
+reach a non-NONE best-effort saturation policy. Bit-parity with the
+scalar `solve_greedy` — allocations AND DegradationEvents — is asserted
+over the edge-fleet fixtures in tests/test_capacity_solver.py.
+
+Servers whose candidates are plain dicts (zero-load shortcut, sizing-
+cache replays, scalar-sized systems) ride the same machinery as
+extension rows, so mixed fleets solve in one pass. `GREEDY_VECTORIZED=0`
+forces the scalar path for A/B debugging.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+import os
+
+import numpy as np
+
+from inferno_tpu.config.defaults import (
+    DEFAULT_SERVICE_CLASS_PRIORITY,
+    SaturationPolicy,
+)
+from inferno_tpu.config.types import OptimizerSpec
+from inferno_tpu.core.system import System
+from inferno_tpu.solver.greedy import (
+    DEGRADE_ZEROED,
+    DegradationEvent,
+    _best_effort,
+    _chips_per_replica,
+    _classify_step,
+    _ServerEntry,
+    candidate_sort_key,
+    parse_policy,
+    solve_greedy,
+)
+
+
+def _vec_enabled() -> bool:
+    return os.environ.get("GREEDY_VECTORIZED", "true").lower() not in (
+        "0", "false", "no", "off",
+    )
+
+
+class _ArrayLedger:
+    """Array form of `greedy.CapacityLedger`: remaining chips per bucket
+    (pool budgets + quota carve-outs) with accelerator-RANK addressing
+    for the vectorized loop and accelerator-NAME addressing for the
+    scalar best-effort helpers. Bucket order per accelerator matches the
+    scalar ledger exactly: pool budget, then "pool/region" quota, then
+    pool-wide quota — fits, takes, and shortfall reports are
+    bit-identical."""
+
+    def __init__(self, system: System):
+        accs = sorted(system.accelerators)
+        self.acc_order = {a: i for i, a in enumerate(accs)}
+        quotas = dict(getattr(system, "quotas", {}) or {})
+        pools: list[str] = []
+        pool_id: dict[str, int] = {}
+        quota_keys: list[str] = []
+        quota_id: dict[str, int] = {}
+        rank_pid, rank_q1, rank_q2 = [], [], []
+        for name in accs:
+            acc = system.accelerators[name]
+            pid = pool_id.setdefault(acc.pool, len(pools))
+            if pid == len(pools):
+                pools.append(acc.pool)
+            rank_pid.append(pid)
+            region_key = f"{acc.pool}/{acc.region}" if acc.region else None
+            if region_key is not None and region_key in quotas:
+                qid = quota_id.setdefault(region_key, len(quota_keys))
+                if qid == len(quota_keys):
+                    quota_keys.append(region_key)
+                rank_q1.append(qid)
+            else:
+                rank_q1.append(-1)
+            if acc.pool in quotas:
+                qid = quota_id.setdefault(acc.pool, len(quota_keys))
+                if qid == len(quota_keys):
+                    quota_keys.append(acc.pool)
+                rank_q2.append(qid)
+            else:
+                rank_q2.append(-1)
+        self.pools = pools
+        self.quota_keys = quota_keys
+        self.pool_remaining = np.asarray(
+            [system.capacity.get(p, 0) for p in pools], np.int64
+        )
+        self.quota_remaining = np.asarray(
+            [quotas[k] for k in quota_keys], np.int64
+        )
+        self.rank_pid = np.asarray(rank_pid, np.int64)
+        self.rank_q1 = np.asarray(rank_q1, np.int64)
+        self.rank_q2 = np.asarray(rank_q2, np.int64)
+
+    # -- rank-addressed (the vectorized loop) -------------------------------
+
+    def fits_rank(self, rank: int, need: int) -> bool:
+        if self.pool_remaining[self.rank_pid[rank]] < need:
+            return False
+        q1, q2 = self.rank_q1[rank], self.rank_q2[rank]
+        if q1 >= 0 and self.quota_remaining[q1] < need:
+            return False
+        return not (q2 >= 0 and self.quota_remaining[q2] < need)
+
+    def take_rank(self, rank: int, need: int) -> None:
+        self.pool_remaining[self.rank_pid[rank]] -= need
+        q1, q2 = self.rank_q1[rank], self.rank_q2[rank]
+        if q1 >= 0:
+            self.quota_remaining[q1] -= need
+        if q2 >= 0:
+            self.quota_remaining[q2] -= need
+
+    def headroom_rank(self, rank: int) -> int:
+        room = self.pool_remaining[self.rank_pid[rank]]
+        q1, q2 = self.rank_q1[rank], self.rank_q2[rank]
+        if q1 >= 0:
+            room = min(room, self.quota_remaining[q1])
+        if q2 >= 0:
+            room = min(room, self.quota_remaining[q2])
+        return int(room)
+
+    def shortfall_rank(self, rank: int, need: int) -> tuple[str, int]:
+        pid = self.rank_pid[rank]
+        if self.pool_remaining[pid] < need:
+            return self.pools[pid], int(need - self.pool_remaining[pid])
+        for q in (self.rank_q1[rank], self.rank_q2[rank]):
+            if q >= 0 and self.quota_remaining[q] < need:
+                return self.quota_keys[q], int(need - self.quota_remaining[q])
+        return self.pools[pid], 0
+
+    # -- bulk (the fast bucket path) ----------------------------------------
+
+    def bulk_fits(self, ranks: np.ndarray, needs: np.ndarray) -> bool:
+        pool_demand = np.bincount(
+            self.rank_pid[ranks], weights=needs,
+            minlength=len(self.pool_remaining),
+        )
+        if np.any(pool_demand > self.pool_remaining):
+            return False
+        for qids in (self.rank_q1[ranks], self.rank_q2[ranks]):
+            m = qids >= 0
+            if m.any():
+                demand = np.bincount(
+                    qids[m], weights=needs[m],
+                    minlength=len(self.quota_remaining),
+                )
+                if np.any(demand > self.quota_remaining):
+                    return False
+        return True
+
+    def bulk_take(self, ranks: np.ndarray, needs: np.ndarray) -> None:
+        self.pool_remaining -= np.bincount(
+            self.rank_pid[ranks], weights=needs,
+            minlength=len(self.pool_remaining),
+        ).astype(np.int64)
+        for qids in (self.rank_q1[ranks], self.rank_q2[ranks]):
+            m = qids >= 0
+            if m.any():
+                self.quota_remaining -= np.bincount(
+                    qids[m], weights=needs[m],
+                    minlength=len(self.quota_remaining),
+                ).astype(np.int64)
+
+    # -- name-addressed (the scalar best-effort helpers) --------------------
+
+    def _rank(self, acc_name: str) -> int | None:
+        return self.acc_order.get(acc_name)
+
+    def fits(self, acc_name: str, need: int) -> bool:
+        rank = self._rank(acc_name)
+        return need <= 0 if rank is None else self.fits_rank(rank, need)
+
+    def take(self, acc_name: str, need: int) -> None:
+        rank = self._rank(acc_name)
+        if rank is not None:
+            self.take_rank(rank, need)
+
+    def headroom(self, acc_name: str) -> int:
+        rank = self._rank(acc_name)
+        return 0 if rank is None else self.headroom_rank(rank)
+
+    def shortfall(self, acc_name: str, need: int) -> tuple[str, int]:
+        rank = self._rank(acc_name)
+        return ("", need) if rank is None else self.shortfall_rank(rank, need)
+
+
+def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
+    """Capacity-constrained solve routed through the columnar candidate
+    table when one is attached (batched sizing ran this cycle); falls
+    back to the scalar `solve_greedy` otherwise — results are
+    bit-identical either way."""
+    cands = getattr(system, "fleet_candidates", None)
+    if cands is None or not _vec_enabled():
+        solve_greedy(system, optimizer_spec)
+        return
+    # local import: parallel.fleet imports jax; solver modules must stay
+    # importable without it only through the scalar path above
+    from inferno_tpu.parallel.fleet import LaneAllocations
+
+    system.degradations = {}
+    ledger = _ArrayLedger(system)
+    names = list(system.servers)
+    servers_list = list(system.servers.values())
+    acc_names = sorted(system.accelerators)
+
+    # table segment per server position
+    seg_of = {int(p): i for i, p in enumerate(cands.seg_server)}
+
+    # -- assemble the global candidate arrays: table rows + ext rows for
+    # plain-dict servers (zero-load shortcut, cache replays) ----------------
+    n_table = cands.num_rows
+    ext_val: list[float] = []
+    ext_cost: list[float] = []
+    ext_reps: list[int] = []
+    ext_chips: list[int] = []
+    ext_rank: list[int] = []
+    direct: dict[int, object] = {}  # global row -> Allocation (ext rows)
+
+    e_pos: list[int] = []  # entry -> server position
+    e_start: list[int] = []
+    e_end: list[int] = []
+
+    for pos, server in enumerate(servers_list):
+        server.remove_allocation()
+        allocs = server.all_allocations
+        if (
+            isinstance(allocs, LaneAllocations)
+            and getattr(allocs, "_src", None) is cands.src
+            and pos in seg_of
+        ):
+            i = seg_of[pos]
+            e_pos.append(pos)
+            e_start.append(int(cands.bounds[i]))
+            e_end.append(int(cands.bounds[i + 1]))
+            continue
+        if not allocs:
+            continue
+        ordered = sorted(allocs.values(), key=candidate_sort_key)
+        start = n_table + len(ext_val)
+        for alloc in ordered:
+            pc = _chips_per_replica(system, names[pos], alloc)
+            ext_val.append(float(alloc.value))
+            ext_cost.append(float(alloc.cost))
+            ext_reps.append(int(alloc.num_replicas))
+            if pc is None:
+                # the scalar loop drops the whole entry when it pops an
+                # unresolvable candidate; the sentinel replays that
+                ext_chips.append(-1)
+                ext_rank.append(-1)
+            else:
+                ext_chips.append(pc[1])
+                ext_rank.append(ledger.acc_order[pc[0]])
+            direct[n_table + len(ext_val) - 1] = alloc
+        e_pos.append(pos)
+        e_start.append(start)
+        e_end.append(n_table + len(ext_val))
+
+    if not e_pos:
+        return
+
+    if ext_val:
+        g_value = np.concatenate([cands.value, np.asarray(ext_val, np.float64)])
+        g_cost = np.concatenate([cands.cost, np.asarray(ext_cost, np.float64)])
+        g_reps = np.concatenate([cands.reps, np.asarray(ext_reps, np.int64)])
+        g_chips = np.concatenate([cands.chips, np.asarray(ext_chips, np.int64)])
+        g_rank = np.concatenate([cands.rank, np.asarray(ext_rank, np.int64)])
+    else:
+        g_value, g_cost = cands.value, cands.cost
+        g_reps, g_chips, g_rank = cands.reps, cands.chips, cands.rank
+    g_kind, g_lane = cands.kind, cands.lane
+
+    e_pos_a = np.asarray(e_pos, np.int64)
+    e_start_a = np.asarray(e_start, np.int64)
+    e_end_a = np.asarray(e_end, np.int64)
+    class_prio = {
+        name: svc.priority for name, svc in system.service_classes.items()
+    }
+    e_prio = np.asarray(
+        [
+            class_prio.get(
+                servers_list[p].service_class_name, DEFAULT_SERVICE_CLASS_PRIORITY
+            )
+            for p in e_pos
+        ],
+        np.int64,
+    )
+    value0 = g_value[e_start_a]
+    delta0 = np.where(
+        e_end_a - e_start_a > 1,
+        g_value[np.minimum(e_start_a + 1, len(g_value) - 1)] - g_value[e_start_a],
+        np.inf,
+    )
+    # the scalar entry order: stable sort by (priority, -delta, -value)
+    order = np.lexsort((-value0, -delta0, e_prio))
+
+    cur = np.zeros(len(e_pos), np.int64)
+    pending: list[tuple[str, int] | None] = [None] * len(e_pos)
+
+    def materialize(row: int, pos: int):
+        if row < n_table:
+            return servers_list[pos].all_allocations.lane_alloc(
+                int(g_kind[row]), int(g_lane[row])
+            )
+        return direct[row]
+
+    def preferred_shape(e: int) -> tuple[str, int]:
+        """(accelerator, replicas) of the entry's preferred candidate,
+        read from the arrays — no materialization."""
+        row = int(e_start_a[e])
+        rank = int(g_rank[row])
+        acc = acc_names[rank] if 0 <= rank < len(acc_names) else ""
+        return acc, int(g_reps[row])
+
+    def emit(e: int, step: str, to_acc: str, to_reps: int) -> None:
+        from_acc, from_reps = preferred_shape(e)
+        pool, deficit = pending[e] or ("", 0)
+        name = names[e_pos[e]]
+        system.degradations[name] = DegradationEvent(
+            server=name, step=step, pool=pool, shortfall_chips=deficit,
+            from_accelerator=from_acc, to_accelerator=to_acc,
+            from_replicas=from_reps, to_replicas=to_reps,
+        )
+
+    def allocate_group(group: np.ndarray) -> list[int]:
+        """The SLO-satisfying pass over one priority bucket (or, in
+        delayed mode, the whole fleet). Returns unallocated entry ids in
+        the exact pop order the scalar loop would produce."""
+        # fast bucket path: the whole group's preferred demand fits
+        firsts = e_start_a[group]
+        if np.all(g_chips[firsts] >= 0):
+            needs = g_reps[firsts] * g_chips[firsts]
+            ranks = g_rank[firsts]
+            if ledger.bulk_fits(ranks, needs):
+                ledger.bulk_take(ranks, needs)
+                for e in group:
+                    pos = int(e_pos_a[e])
+                    servers_list[pos].set_allocation(
+                        materialize(int(e_start_a[e]), pos)
+                    )
+                return []
+
+        # exact sequential loop: heap keys replicate the scalar solver's
+        # sorted list + bisect_left reinsertion (a reinserted entry pops
+        # before every queued equal-key entry; newest reinsertion first)
+        heap = [
+            (int(e_prio[e]), -float(delta0[e]), -float(value0[e]), k, int(e))
+            for k, e in enumerate(group)
+        ]
+        reinsert_seq = -1
+        unallocated: list[int] = []
+        while heap:
+            _, _, _, _, e = heapq.heappop(heap)
+            pos = int(e_pos_a[e])
+            row = int(e_start_a[e] + cur[e])
+            chips = int(g_chips[row])
+            if chips < 0:
+                continue  # unresolvable candidate: scalar drops the entry
+            need = int(g_reps[row]) * chips
+            rank = int(g_rank[row])
+            if ledger.fits_rank(rank, need):
+                ledger.take_rank(rank, need)
+                alloc = materialize(row, pos)
+                servers_list[pos].set_allocation(alloc)
+                if cur[e] > 0:
+                    emit(
+                        e,
+                        _classify_step(preferred_shape(e)[0], alloc.accelerator),
+                        alloc.accelerator, int(g_reps[row]),
+                    )
+            else:
+                if cur[e] == 0:
+                    pending[e] = ledger.shortfall_rank(rank, need)
+                cur[e] += 1
+                nxt = int(e_start_a[e] + cur[e])
+                if nxt + 1 < int(e_end_a[e]):
+                    delta = float(g_value[nxt + 1] - g_value[nxt])
+                elif nxt == int(e_end_a[e]):
+                    unallocated.append(e)
+                    continue
+                else:
+                    delta = math.inf
+                heapq.heappush(
+                    heap,
+                    (int(e_prio[e]), -delta, -float(g_value[nxt]),
+                     reinsert_seq, e),
+                )
+                reinsert_seq -= 1
+        return unallocated
+
+    def settle(unallocated: list[int]) -> None:
+        """Best-effort treatment of the group's leftovers per the
+        saturation policy. NONE stays fully lazy (events only); real
+        policies inflate just these servers' candidates and reuse the
+        scalar helpers on the shared ledger."""
+        if not unallocated:
+            return
+        pol = parse_policy(optimizer_spec.saturation_policy)
+        if pol is SaturationPolicy.NONE:
+            for e in unallocated:
+                emit(e, DEGRADE_ZEROED, "", 0)
+            return
+        entries = []
+        for e in unallocated:
+            pos = int(e_pos_a[e])
+            rows = range(int(e_start_a[e]), int(e_end_a[e]))
+            entries.append(
+                _ServerEntry(
+                    server_name=names[pos],
+                    priority=int(e_prio[e]),
+                    cur_index=0,
+                    allocations=[materialize(r, pos) for r in rows],
+                    delta=math.inf,
+                    pending_shortfall=pending[e],
+                )
+            )
+        _best_effort(
+            system, entries, ledger, optimizer_spec.saturation_policy
+        )
+
+    prio_sorted = e_prio[order]
+    if optimizer_spec.delayed_best_effort:
+        settle(allocate_group(order))
+    else:
+        starts = np.flatnonzero(
+            np.r_[True, prio_sorted[1:] != prio_sorted[:-1]]
+        )
+        bounds = np.append(starts, len(order))
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            settle(allocate_group(order[a:b]))

@@ -24,6 +24,9 @@ class OptimizationResult:
     pool_usage: dict[str, PoolUsage]
     solution_time_msec: float  # solver wall-clock (the BASELINE metric)
     analysis_time_msec: float  # candidate-sizing wall-clock
+    # capacity degradations the limited-mode solve recorded (server ->
+    # solver.greedy.DegradationEvent); empty in unlimited mode
+    degradations: dict = dataclasses.field(default_factory=dict)
 
 
 class Optimizer:
@@ -64,6 +67,7 @@ class Optimizer:
             pool_usage=usage,
             solution_time_msec=self.solution_time_msec,
             analysis_time_msec=(t1 - t0) * 1000.0,
+            degradations=dict(getattr(system, "degradations", {}) or {}),
         )
 
 

@@ -12,6 +12,7 @@ from inferno_tpu.config.types import OptimizerSpec
 from inferno_tpu.core.allocation import Allocation, AllocationDiff, allocation_diff
 from inferno_tpu.core.system import System
 from inferno_tpu.solver.greedy import solve_greedy
+from inferno_tpu.solver.greedy_vec import solve_greedy_fleet
 
 
 def solve_unlimited(system: System) -> None:
@@ -59,9 +60,14 @@ class Solver:
         }
 
         if self.optimizer_spec.unlimited:
+            system.degradations = {}
             solve_unlimited(system)
         else:
-            solve_greedy(system, self.optimizer_spec)
+            # limited mode: the vectorized solver consumes the columnar
+            # candidate table when batched sizing attached one
+            # (system.fleet_candidates); systems sized scalar fall back
+            # to the scalar greedy inside — results are bit-identical
+            solve_greedy_fleet(system, self.optimizer_spec)
 
         self.diff_allocation = {}
         for name, server in system.servers.items():

@@ -47,6 +47,8 @@ def fleet_system_spec(
     pinned_every: int = 5,
     infeasible_every: int = 13,
     seed: int = 0,
+    priority_classes: int = 1,
+    split_pools: bool = False,
 ):
     """An N-variant SystemSpec exercising every sizing edge lane.
 
@@ -60,6 +62,15 @@ def fleet_system_spec(
     `pinned_every`-th variant pins candidates to its current shape
     (`keep_accelerator`), and every `infeasible_every`-th variant gets
     an unmeetable ITL target (no feasible lane on any shape).
+
+    `priority_classes` > 1 spreads variants round-robin over that many
+    service classes at distinct priorities (1, 6, 11, ...) — the
+    capacity-constrained solver's priority-bucket fixture; 1 keeps the
+    single-class shape every existing caller relies on. `split_pools`
+    gives each candidate shape its own capacity pool (gen0, gen1, ...)
+    and alternating placement regions (r0/r1), so a binding pool forces
+    cross-pool shape step-downs instead of uniform zeroing — the
+    degradation-ladder fixture; False keeps every shape in the v5e pool.
     """
     import numpy as np
 
@@ -82,9 +93,20 @@ def fleet_system_spec(
     rng = np.random.default_rng(seed)
     shapes = SIZING_SHAPES[: max(shapes_per_variant, 1)]
     accelerators = [
-        AcceleratorSpec(name=name, cost_per_chip_hr=cost) for name, cost in shapes
+        AcceleratorSpec(
+            name=name, cost_per_chip_hr=cost,
+            **({"pool": f"gen{s}", "region": f"r{s % 2}"} if split_pools else {}),
+        )
+        for s, (name, cost) in enumerate(shapes)
     ]
-    models, targets, servers = [], [], []
+    n_classes = max(priority_classes, 1)
+    class_names = (
+        [SERVICE_CLASS]
+        if n_classes == 1
+        else [f"{SERVICE_CLASS}-p{c}" for c in range(n_classes)]
+    )
+    class_targets: list[list] = [[] for _ in range(n_classes)]
+    models, servers = [], []
     for i in range(n_variants):
         model = fleet_model(i)
         tandem = tandem_every and i % tandem_every == tandem_every - 1
@@ -108,7 +130,8 @@ def fleet_system_spec(
                 ),
             ))
         infeasible = infeasible_every and i % infeasible_every == infeasible_every - 1
-        targets.append(ModelTarget(
+        cls = i % n_classes
+        class_targets[cls].append(ModelTarget(
             model=model,
             slo_itl=0.001 if infeasible else 60.0,
             slo_ttft=1.0 if infeasible else 1500.0,
@@ -125,7 +148,7 @@ def fleet_system_spec(
         )
         servers.append(ServerSpec(
             name=f"{FLEET_NS}/{fleet_variant(i)}",
-            class_name=SERVICE_CLASS,
+            class_name=class_names[cls],
             model=model,
             keep_accelerator=bool(pinned),
             min_num_replicas=1,
@@ -134,13 +157,33 @@ def fleet_system_spec(
     return SystemSpec(
         accelerators=accelerators,
         models=models,
-        service_classes=[ServiceClassSpec(
-            name=SERVICE_CLASS, priority=1, model_targets=targets,
-        )],
+        service_classes=[
+            ServiceClassSpec(
+                name=class_names[c], priority=1 + 5 * c,
+                model_targets=class_targets[c],
+            )
+            for c in range(n_classes)
+        ],
         servers=servers,
         optimizer=OptimizerSpec(unlimited=True),
         capacity=CapacitySpec(chips={}),
     )
+
+
+def fleet_capacity(spec, fraction: float = 1.0, backend: str = "jax") -> dict:
+    """Per-pool chip budgets sized at `fraction` of what the
+    UNCONSTRAINED solve of `spec` consumes — the lever for loose
+    (fraction >= 1) vs binding (fraction < 1) capacity fixtures in the
+    greedy parity tests and `bench.py --capacity`."""
+    from inferno_tpu.core import System
+    from inferno_tpu.parallel import calculate_fleet
+    from inferno_tpu.solver.solver import solve_unlimited
+
+    system = System(spec)
+    calculate_fleet(system, backend=backend)
+    solve_unlimited(system)
+    usage = system.allocate_by_pool()
+    return {pool: max(int(u.chips * fraction), 0) for pool, u in usage.items()}
 
 
 def perturb_loads(system, scale: float = 1.02) -> None:

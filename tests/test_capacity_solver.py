@@ -1,0 +1,543 @@
+"""Capacity-constrained fleet solve (ISSUE-7): scalar<->vectorized
+greedy parity over the edge-fleet fixtures, pool/region quota buckets,
+the graceful-degradation ladder, lazy-materialization guarantees, and
+the constrained-vs-unconstrained latency guard.
+
+The scalar `solve_greedy` (solver/greedy.py) is the parity oracle; the
+vectorized `solve_greedy_fleet` (solver/greedy_vec.py) consumes the
+columnar candidate table `calculate_fleet` attaches to the System and
+must agree BIT-FOR-BIT — allocations and DegradationEvents — across
+tight and loose capacity, quotas, every saturation policy, and both
+best-effort modes. Everything here is CPU-jax, fast tier, deterministic.
+"""
+
+import dataclasses
+
+import pytest
+
+from inferno_tpu.config.defaults import SaturationPolicy
+from inferno_tpu.config.types import (
+    AcceleratorSpec,
+    AllocationData,
+    CapacitySpec,
+    DecodeParms,
+    ModelPerfSpec,
+    ModelTarget,
+    OptimizerSpec,
+    PrefillParms,
+    ServerLoadSpec,
+    ServerSpec,
+    ServiceClassSpec,
+    SystemSpec,
+)
+from inferno_tpu.core import System
+from inferno_tpu.core.allocation import Allocation
+from inferno_tpu.parallel import calculate_fleet, reset_fleet_state
+from inferno_tpu.solver.greedy import (
+    DEGRADE_INT8,
+    DEGRADE_REPLICAS,
+    DEGRADE_SHAPE,
+    DEGRADE_ZEROED,
+    solve_greedy,
+)
+from inferno_tpu.solver.greedy_vec import solve_greedy_fleet
+from inferno_tpu.testing.fleet import (
+    fleet_capacity,
+    fleet_system_spec,
+    perturb_loads,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_state():
+    reset_fleet_state()
+    yield
+    reset_fleet_state()
+
+
+def _edge_spec(**kw):
+    """The edge-fleet fixture: tandem, zero-load, pinned, and infeasible
+    variants all present (same shape as the sizing parity suite)."""
+    kw.setdefault("shapes_per_variant", 3)
+    kw.setdefault("priority_classes", 3)
+    return fleet_system_spec(40, **kw)
+
+
+def _solve_both(spec):
+    """Size two identical Systems with the batched path, solve one with
+    the scalar greedy and one vectorized; return both."""
+    a, b = System(spec), System(spec)
+    calculate_fleet(a, backend="jax")
+    calculate_fleet(b, backend="jax")
+    solve_greedy(a, spec.optimizer)
+    solve_greedy_fleet(b, spec.optimizer)
+    return a, b
+
+
+def _assert_bit_parity(scalar: System, fleet: System) -> None:
+    for name in scalar.servers:
+        sa = scalar.servers[name].allocation
+        sb = fleet.servers[name].allocation
+        assert (sa is None) == (sb is None), name
+        if sa is not None:
+            assert (
+                sa.accelerator, sa.num_replicas, sa.batch_size,
+                sa.cost, sa.value,
+            ) == (
+                sb.accelerator, sb.num_replicas, sb.batch_size,
+                sb.cost, sb.value,
+            ), name
+    assert scalar.degradations == fleet.degradations
+
+
+@pytest.mark.parametrize("fraction", [1.2, 1.0, 0.5])
+def test_vectorized_matches_scalar_tight_and_loose(fraction):
+    """Bit-parity over the edge fleet at loose (everything fits), exact,
+    and binding capacity — allocations AND degradation events."""
+    spec = _edge_spec()
+    cap = fleet_capacity(spec, fraction)
+    reset_fleet_state()
+    spec.capacity = CapacitySpec(chips=cap)
+    spec.optimizer = OptimizerSpec(unlimited=False)
+    scalar, fleet = _solve_both(spec)
+    _assert_bit_parity(scalar, fleet)
+    if fraction >= 1.0:
+        assert not fleet.degradations
+    else:
+        assert fleet.degradations  # a binding pool really degraded someone
+
+
+def test_vectorized_matches_scalar_with_quotas_and_regions():
+    """Split pools + a per-region quota + a pool-wide quota: the quota
+    buckets bind before the pool budgets and both solvers must walk the
+    same ladder."""
+    spec = _edge_spec(split_pools=True)
+    cap = fleet_capacity(spec, 1.0)
+    reset_fleet_state()
+    quotas = {
+        f"{pool}/r0": max(chips // 3, 4)
+        for pool, chips in cap.items()
+        if pool == "gen0"
+    }
+    quotas["gen1"] = max(cap.get("gen1", 8) // 2, 4)
+    spec.capacity = CapacitySpec(chips=cap, quotas=quotas)
+    spec.optimizer = OptimizerSpec(unlimited=False)
+    scalar, fleet = _solve_both(spec)
+    _assert_bit_parity(scalar, fleet)
+    assert fleet.degradations
+    # at least one shortfall names a QUOTA bucket, not a bare pool
+    assert any(
+        e.pool in quotas for e in fleet.degradations.values()
+    ), fleet.degradations
+
+
+@pytest.mark.parametrize("policy", [
+    SaturationPolicy.NONE.value,
+    SaturationPolicy.PRIORITY_EXHAUSTIVE.value,
+    SaturationPolicy.PRIORITY_ROUND_ROBIN.value,
+    SaturationPolicy.ROUND_ROBIN.value,
+])
+@pytest.mark.parametrize("delayed", [False, True])
+def test_saturation_policy_parity(policy, delayed):
+    """Every saturation policy x both best-effort modes: the vectorized
+    path hands its leftovers to the same best-effort machinery over the
+    same ledger state."""
+    spec = _edge_spec()
+    cap = fleet_capacity(spec, 0.5)
+    reset_fleet_state()
+    spec.capacity = CapacitySpec(chips=cap)
+    spec.optimizer = OptimizerSpec(
+        unlimited=False, saturation_policy=policy, delayed_best_effort=delayed
+    )
+    scalar, fleet = _solve_both(spec)
+    _assert_bit_parity(scalar, fleet)
+
+
+def test_no_dict_inflation_on_vectorized_path():
+    """Acceptance (ISSUE-7): the vectorized constrained solve never
+    inflates per-server candidate dicts — the lazy-materialization
+    counter stays at O(allocated servers), a fraction of the lane
+    count, and unallocated servers materialize nothing under policy
+    NONE."""
+    from inferno_tpu.parallel import LaneAllocations
+
+    spec = _edge_spec()
+    cap = fleet_capacity(spec, 0.6)
+    reset_fleet_state()
+    spec.capacity = CapacitySpec(chips=cap)
+    spec.optimizer = OptimizerSpec(unlimited=False)
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    before = system.fleet_candidates.src.materialized
+    assert before == 0  # sizing alone materializes nothing
+    solve_greedy_fleet(system, spec.optimizer)
+    allocated = sum(
+        1 for s in system.servers.values() if s.allocation is not None
+    )
+    lanes = system.fleet_candidates.num_rows
+    materialized = system.fleet_candidates.src.materialized
+    # one Allocation per allocated laned server, nothing else; well
+    # below full inflation (zero-load winners are plain-dict, not lanes)
+    assert materialized <= allocated
+    assert materialized < lanes
+    # spot-check: laned servers still carry their lazy views
+    lazy = [
+        s for s in system.servers.values()
+        if isinstance(s.all_allocations, LaneAllocations)
+        and s.all_allocations._src is not None
+    ]
+    assert lazy, "every lazy view was inflated"
+
+
+def test_vectorized_env_kill_switch(monkeypatch):
+    """GREEDY_VECTORIZED=0 routes solve_greedy_fleet to the scalar
+    implementation — same answer, via dict inflation."""
+    spec = _edge_spec(shapes_per_variant=2)
+    cap = fleet_capacity(spec, 0.7)
+    reset_fleet_state()
+    spec.capacity = CapacitySpec(chips=cap)
+    spec.optimizer = OptimizerSpec(unlimited=False)
+    scalar, fleet = _solve_both(spec)
+    _assert_bit_parity(scalar, fleet)
+    reset_fleet_state()
+    monkeypatch.setenv("GREEDY_VECTORIZED", "0")
+    off = System(spec)
+    calculate_fleet(off, backend="jax")
+    solve_greedy_fleet(off, spec.optimizer)
+    _assert_bit_parity(scalar, off)
+
+
+# -- the degradation ladder (crafted, exact) ---------------------------------
+
+SHAPES = [
+    AcceleratorSpec(name="v5e-4", cost_per_chip_hr=1.0),
+    AcceleratorSpec(name="v5e-4-int8", pool="v5e", chips=4, cost_per_chip_hr=0.5),
+    AcceleratorSpec(name="v5p-8", cost_per_chip_hr=2.0),
+]
+
+
+def _crafted_system(candidates, capacity, policy="None", quotas=None):
+    spec = SystemSpec(
+        accelerators=list(SHAPES),
+        models=[
+            ModelPerfSpec(
+                name="m", acc=a.name, max_batch_size=16, at_tokens=128,
+                decode_parms=DecodeParms(10.0, 0.2),
+                prefill_parms=PrefillParms(3.0, 0.01),
+            )
+            for a in SHAPES
+        ],
+        service_classes=[ServiceClassSpec(
+            name="Premium", priority=1,
+            model_targets=[ModelTarget(model="m", slo_itl=60.0)],
+        )],
+        servers=[
+            ServerSpec(
+                name=name, class_name="Premium", model="m", min_num_replicas=1,
+                current_alloc=AllocationData(load=ServerLoadSpec(600.0, 128, 64)),
+            )
+            for name in candidates
+        ],
+        optimizer=OptimizerSpec(unlimited=False, saturation_policy=policy),
+        capacity=CapacitySpec(chips=capacity, quotas=quotas or {}),
+    )
+    system = System(spec)
+    for name, cands in candidates.items():
+        system.servers[name].all_allocations = {
+            acc: _alloc(acc, reps, val) for acc, (reps, val) in cands.items()
+        }
+    system.candidates_calculated = True
+    return system, spec
+
+
+def _alloc(acc, replicas, value):
+    a = Allocation(
+        accelerator=acc, num_replicas=replicas, batch_size=16,
+        cost=value, max_arrv_rate_per_replica=0.01,
+    )
+    a.value = value
+    return a
+
+
+def test_ladder_shape_step_down():
+    """Preferred pool short, another pool open: the shape rung, with the
+    shortfall of the PREFERRED candidate recorded."""
+    system, spec = _crafted_system(
+        {"s": {"v5e-4": (4, 10.0), "v5p-8": (2, 30.0)}},
+        capacity={"v5e": 8, "v5p": 16},
+    )
+    solve_greedy(system, spec.optimizer)
+    e = system.degradations["s"]
+    assert e.step == DEGRADE_SHAPE
+    assert (e.from_accelerator, e.to_accelerator) == ("v5e-4", "v5p-8")
+    assert e.pool == "v5e" and e.shortfall_chips == 8  # needed 16, had 8
+    assert (e.from_replicas, e.to_replicas) == (4, 2)
+
+
+def test_ladder_int8_step_down():
+    """Stepping onto a quantized -int8 catalog entry is the int8 rung."""
+    system, spec = _crafted_system(
+        {"s": {"v5e-4": (10, 100.0), "v5e-4-int8": (5, 120.0)}},
+        capacity={"v5e": 24},
+    )
+    solve_greedy(system, spec.optimizer)
+    e = system.degradations["s"]
+    assert e.step == DEGRADE_INT8
+    assert e.to_accelerator == "v5e-4-int8"
+    assert e.shortfall_chips == 16  # needed 40, had 24
+
+
+def test_ladder_replica_scale_down_and_zeroed():
+    """Best-effort scaling is the replicas rung; policy None leaves the
+    zeroed rung with the same shortfall anchor."""
+    cands = {"s": {"v5e-4": (10, 100.0)}}
+    scaled, spec = _crafted_system(
+        cands, capacity={"v5e": 24}, policy="PriorityExhaustive"
+    )
+    solve_greedy(scaled, spec.optimizer)
+    e = scaled.degradations["s"]
+    assert e.step == DEGRADE_REPLICAS
+    assert (e.from_replicas, e.to_replicas) == (10, 6)  # 24 chips = 6x4
+    assert scaled.servers["s"].allocation.num_replicas == 6
+
+    zeroed, spec = _crafted_system(cands, capacity={"v5e": 2}, policy="None")
+    solve_greedy(zeroed, spec.optimizer)
+    e = zeroed.degradations["s"]
+    assert e.step == DEGRADE_ZEROED
+    assert e.to_accelerator == "" and e.shortfall_chips == 38
+    assert zeroed.servers["s"].allocation is None
+
+
+def test_mixed_lanes_and_cache_replayed_dicts_parity():
+    """Sizing-cache replays hand the solver PLAIN candidate dicts while
+    freshly sized servers carry lazy lane views — one limited solve must
+    handle the mix and still match the scalar oracle bit-for-bit (the
+    cache-on reconcile cycle's exact shape)."""
+    spec = _edge_spec(shapes_per_variant=2)
+    cap = fleet_capacity(spec, 0.6)
+    reset_fleet_state()
+    spec.capacity = CapacitySpec(chips=cap)
+    spec.optimizer = OptimizerSpec(unlimited=False)
+    a, b = System(spec), System(spec)
+    calculate_fleet(a, backend="jax")
+    calculate_fleet(b, backend="jax")
+    # replay half of b's servers as plain dicts (what SizingCache.lookup
+    # returns: cloned allocations with recomputed values)
+    for i, server in enumerate(b.servers.values()):
+        if i % 2 == 0 and server.all_allocations:
+            server.all_allocations = {
+                acc: alloc.clone()
+                for acc, alloc in server.all_allocations.items()
+            }
+    solve_greedy(a, spec.optimizer)
+    solve_greedy_fleet(b, spec.optimizer)
+    _assert_bit_parity(a, b)
+
+
+def test_greedy_tie_break_deterministic_both_orders():
+    """Equal-value equal-cost candidates must resolve by accelerator
+    name — NOT dict insertion order — in the scalar greedy, matching
+    solve_unlimited and the vectorized argmin (ISSUE-7 satellite: the
+    candidate sort previously keyed on value alone)."""
+    a = _alloc("v5p-8", 1, 10.0)
+    b = _alloc("v5e-4", 2, 10.0)  # same value, same cost; "v5e-4" < "v5p-8"
+    for order in ((a, b), (b, a)):
+        system, spec = _crafted_system(
+            {"s": {}}, capacity={"v5e": 64, "v5p": 64}
+        )
+        system.servers["s"].all_allocations = {
+            x.accelerator: x for x in order
+        }
+        solve_greedy(system, spec.optimizer)
+        chosen = system.servers["s"].allocation
+        assert chosen is not None and chosen.accelerator == "v5e-4", order
+
+
+def test_quota_binds_before_pool():
+    """A region quota tighter than the pool budget is the binding bucket:
+    the shortfall names the quota key, and consumption is charged to
+    both the pool and the quota."""
+    region_shapes = [
+        AcceleratorSpec(name="v5e-4", cost_per_chip_hr=1.0, region="us-east1"),
+    ]
+    spec = SystemSpec(
+        accelerators=region_shapes,
+        models=[ModelPerfSpec(
+            name="m", acc="v5e-4", max_batch_size=16, at_tokens=128,
+            decode_parms=DecodeParms(10.0, 0.2),
+            prefill_parms=PrefillParms(3.0, 0.01),
+        )],
+        service_classes=[ServiceClassSpec(
+            name="Premium", priority=1,
+            model_targets=[ModelTarget(model="m", slo_itl=60.0)],
+        )],
+        servers=[ServerSpec(
+            name="s", class_name="Premium", model="m", min_num_replicas=1,
+            current_alloc=AllocationData(load=ServerLoadSpec(600.0, 128, 64)),
+        )],
+        optimizer=OptimizerSpec(unlimited=False),
+        capacity=CapacitySpec(
+            chips={"v5e": 64}, quotas={"v5e/us-east1": 8}
+        ),
+    )
+    system = System(spec)
+    system.servers["s"].all_allocations = {"v5e-4": _alloc("v5e-4", 4, 10.0)}
+    system.candidates_calculated = True
+    solve_greedy(system, spec.optimizer)
+    assert system.servers["s"].allocation is None  # 16 chips > 8 quota
+    e = system.degradations["s"]
+    assert e.pool == "v5e/us-east1" and e.shortfall_chips == 8
+
+    # within quota: allocation succeeds and charges both buckets
+    spec2 = dataclasses.replace(
+        spec, capacity=CapacitySpec(chips={"v5e": 64},
+                                    quotas={"v5e/us-east1": 16}),
+    )
+    system2 = System(spec2)
+    system2.servers["s"].all_allocations = {"v5e-4": _alloc("v5e-4", 4, 10.0)}
+    system2.candidates_calculated = True
+    solve_greedy(system2, spec2.optimizer)
+    assert system2.servers["s"].allocation is not None
+    assert not system2.degradations
+
+
+def test_capacity_spec_quota_and_region_roundtrip():
+    """CapacitySpec.quotas and AcceleratorSpec.region survive the
+    to_dict/from_dict wire round trip (ConfigMap/JSON path)."""
+    cap = CapacitySpec(chips={"v5e": 64}, quotas={"v5e/us-east1": 16})
+    assert CapacitySpec.from_dict(cap.to_dict()) == cap
+    assert CapacitySpec.from_dict({"chips": {"v5e": 4}}).quotas == {}
+    acc = AcceleratorSpec(name="v5e-4", cost_per_chip_hr=1.0, region="us-east1")
+    assert AcceleratorSpec.from_dict(acc.to_dict()).region == "us-east1"
+
+
+def test_sizing_cache_invalidates_on_quota_change():
+    """Acceptance wiring: quota state joins the sizing-cache input
+    signature — editing a quota is a structural miss, exactly like a
+    capacity edit."""
+    from inferno_tpu.controller.sizing_cache import (
+        SizingCache,
+        server_signature,
+        system_fingerprint,
+    )
+
+    spec = _edge_spec(shapes_per_variant=2)
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    name, server = next(iter(system.servers.items()))
+    fp1 = system_fingerprint(system)
+    sig1 = server_signature(server, system, fp1)
+    cache = SizingCache(rel_tolerance=0.05)
+    lam = server.load.arrival_rate
+    cache.store(name, sig1, lam, server.all_allocations)
+    assert cache.lookup(name, sig1, lam, server.cur_allocation) is not None
+
+    system.quotas["v5e/us-east1"] = 32
+    fp2 = system_fingerprint(system)
+    sig2 = server_signature(server, system, fp2)
+    assert sig2 != sig1
+    assert cache.lookup(name, sig2, lam, server.cur_allocation) is None
+
+
+def test_optimizer_result_carries_degradations():
+    """Optimizer.optimize surfaces the solve's degradation events so the
+    reconciler (and bench) read them without reaching into the System."""
+    from inferno_tpu.solver import optimize
+
+    spec = _edge_spec(shapes_per_variant=2)
+    cap = fleet_capacity(spec, 0.5)
+    reset_fleet_state()
+    spec.capacity = CapacitySpec(chips=cap)
+    spec.optimizer = OptimizerSpec(unlimited=False)
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    result = optimize(system, spec.optimizer)
+    assert result.degradations
+    assert result.degradations == system.degradations
+
+
+def test_constrained_budget_500_variants():
+    """Fast-tier regression guard (mirrors the 500-variant sizing
+    budget): a constrained 500-variant solve stays within a fixed
+    multiple of the unconstrained pass on the same fleet — a return of
+    O(servers x candidates) dict inflation cannot land silently."""
+    import time
+
+    spec = fleet_system_spec(500, shapes_per_variant=1)
+    cap = fleet_capacity(spec, 0.8)
+    reset_fleet_state()
+
+    def timed(constrained: bool) -> float:
+        reset_fleet_state()
+        s = fleet_system_spec(500, shapes_per_variant=1)
+        if constrained:
+            s.capacity = CapacitySpec(chips=cap)
+            s.optimizer = OptimizerSpec(unlimited=False)
+        system = System(s)
+        calculate_fleet(system, backend="jax")  # jit warmup, uncounted
+        times = []
+        for _ in range(3):
+            perturb_loads(system)
+            t0 = time.perf_counter()
+            calculate_fleet(system, backend="jax")
+            if constrained:
+                solve_greedy_fleet(system, s.optimizer)
+            else:
+                from inferno_tpu.solver.solver import solve_unlimited
+
+                solve_unlimited(system)
+            times.append((time.perf_counter() - t0) * 1000.0)
+        return min(times)
+
+    unconstrained_ms = timed(False)
+    constrained_ms = timed(True)
+    # 3x the unconstrained pass with a floor against timer noise on a
+    # loaded box (same guard philosophy as the sizing budget test)
+    budget = 3.0 * max(unconstrained_ms, 100.0)
+    assert constrained_ms <= budget, (
+        f"constrained 500-variant solve took {constrained_ms:.0f}ms "
+        f"(unconstrained {unconstrained_ms:.0f}ms, budget {budget:.0f}ms); "
+        "the vectorized greedy path regressed"
+    )
+
+
+def test_compact_line_carries_capacity_keys():
+    """Bench wiring: capacity_10k_ms and the degradation count ride the
+    compact line when the capacity block is present."""
+    import json
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench
+
+    ns_stub = {
+        "chosen_shape": "v5e-4-int8",
+        "per_shape_provenance": {"v5e-4-int8": "measured"},
+        "a100": {"usd_per_mtok": 0.2},
+        "tpu": {"usd_per_mtok": 0.125},
+        "vs_baseline": 1.27,
+    }
+    capacity = {
+        "points": [
+            {"fraction": 0.5, "solve_ms": 1234.5, "total_degraded": 42},
+        ],
+    }
+    line = bench.compact_line(
+        ns_stub, {"platform": "cpu", "auto_selected_ms": 1.0},
+        {"probed": True, "reachable": False}, capacity=capacity,
+    )
+    doc = json.loads(line)
+    assert doc["extra"]["capacity_10k_ms"] == 1234.5
+    assert doc["extra"]["capacity_degraded"] == 42
+
+
+def test_capacity_suite_stays_in_fast_tier():
+    """No test in this module may carry the `slow` marker — the parity
+    and budget assertions must stay inside tier-1's `-m 'not slow'`
+    run."""
+    import pathlib
+
+    marker = "mark." + "slow"  # split so this line doesn't self-match
+    text = (pathlib.Path(__file__).parent / "test_capacity_solver.py").read_text()
+    assert marker not in text

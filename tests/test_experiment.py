@@ -139,10 +139,19 @@ def test_light_load_ttft_close_to_service_time():
     assert res["ttft_ms"]["p50"] < 40.0, res["ttft_ms"]
 
 
+@pytest.mark.slow
 def test_disagg_scenario_reports_tandem_model():
     """The driver's disagg variation: a DisaggEngine replica unit under
     steady load, with the model prediction coming from the TANDEM
-    analyzer (kv transfer folded into gamma) and a small ITL error."""
+    analyzer (kv transfer folded into gamma) and a small ITL error.
+
+    Marked slow (deflake audit, ISSUE-7): the DisaggEngine's virtual
+    clock divides WALL-slept time, so even the emu-ms model_error band
+    here carries host scheduling noise — the same emu-vs-wall flake
+    class as the closed-loop disagg tests already moved to the slow
+    tier (it flaked alongside them whenever the box ran concurrent
+    load). The aggregated-engine scenarios above stay fast: their
+    virtual clock is discrete-event, immune to host jitter."""
     from inferno_tpu.emulator.disagg import DisaggProfile
 
     sc = Scenario(

@@ -185,7 +185,12 @@ def test_decision_reason_capacity_limited():
     (d,) = report.decisions
     assert d.reason == REASON_CAPACITY_LIMITED
     assert d.replicas == 1  # the floor
-    assert "no feasible allocation" in d.detail
+    # the degradation ladder enriches the detail with the chip shortfall
+    # of the preferred candidate in the binding pool (ISSUE-7)
+    assert "zeroed by capacity" in d.detail
+    assert d.degradation_step == "zeroed"
+    assert d.chip_shortfall > 0
+    assert "v5e" in d.detail
 
 
 def test_decision_reason_error_on_optimize_failure(monkeypatch):
