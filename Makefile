@@ -6,8 +6,8 @@ IMG ?= inferno-tpu-autoscaler:latest
 CLUSTER ?= inferno-tpu
 
 .PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
-        bench-sizing bench-capacity native lint lint-metrics manifests-sync \
-        docker-build deploy-kind deploy undeploy clean
+        bench-sizing bench-capacity bench-planner native lint lint-metrics \
+        manifests-sync docker-build deploy-kind deploy undeploy clean
 
 all: native test
 
@@ -48,6 +48,12 @@ bench-sizing:
 # with graceful-degradation counts; recorded in bench_full.json
 bench-capacity:
 	$(PYTHON) bench.py --capacity
+
+# Batched time-axis replay benchmark (ISSUE-8): a 10k-variant diurnal
+# week (168 hourly steps) in one calculate_fleet_batch pass vs the
+# serial per-timestep loop; recorded in bench_full.json
+bench-planner:
+	$(PYTHON) bench.py --planner
 
 # Synthetic 200-variant reconcile-cycle benchmark: serial per-variant
 # collection vs coalesced queries + concurrency + sizing cache

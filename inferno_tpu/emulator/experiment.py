@@ -74,6 +74,29 @@ class RunStats:
     submitted: int = 0
 
 
+def rate_trace(
+    spec: RateSpec, steps: int, step_seconds: float, repeat: bool = False
+):
+    """Sample a piecewise `RateSpec` at step midpoints into a [steps]
+    rate vector — the bridge from the emulator's schedule language
+    (`RateSpec`, `RateSpec.ramp`) to the planner's per-timestep rate
+    arrays (inferno_tpu.planner.scenarios). Midpoint sampling keeps a
+    ramp's time-averaged rate exact regardless of the step count, the
+    same convention as `RateSpec.ramp` itself. `repeat=True` tiles the
+    schedule periodically (a diurnal day replayed over a week); past the
+    schedule's end `rate_at` returns 0 otherwise."""
+    import numpy as np
+
+    if steps < 0 or step_seconds <= 0:
+        raise ValueError(
+            f"need steps >= 0 and step_seconds > 0, got {steps}, {step_seconds}"
+        )
+    ts = (np.arange(steps, dtype=np.float64) + 0.5) * step_seconds
+    if repeat and spec.total_duration > 0:
+        ts = ts % spec.total_duration
+    return np.asarray([spec.rate_at(float(t)) for t in ts], np.float64)
+
+
 def _percentile(xs: list[float], q: float) -> float:
     if not xs:
         return 0.0

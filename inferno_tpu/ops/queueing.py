@@ -322,6 +322,28 @@ def _bisect_increasing(
     return lam, feasible
 
 
+def offered_load(total_rate, target_tps, out_tokens, xp=jnp):
+    """Effective offered load per lane: TPS targets replace the arrival
+    rate (reference: pkg/core/allocation.go:133-141). `xp` selects the
+    array namespace — jnp inside the jitted sizing programs, np on the
+    batched time-axis host path (parallel.fleet.calculate_fleet_batch) —
+    so both compute the identical f32 expression."""
+    return xp.where(target_tps > 0, target_tps / out_tokens, total_rate)
+
+
+def fold_replicas(total, rate_star, min_replicas, xp=jnp):
+    """Replica count for offered load `total` at per-replica capacity
+    `rate_star`: the exact ceil/max fold of `fleet_size` (f32 divide,
+    ceil, int32 cast, min-replica and >=1 clamps, in that order). Shared
+    by the jitted kernels and the batched time-axis replay so a host-side
+    numpy replay of T timesteps is bit-identical to T jitted solves —
+    `rate_star` is rate-independent, so the replay hoists the bisection
+    out of the time axis and only this fold runs per timestep."""
+    replicas = xp.ceil(total / rate_star).astype("int32")
+    replicas = xp.maximum(replicas, min_replicas)
+    return xp.maximum(replicas, 1)
+
+
 def fleet_analyze(lam: jax.Array, params: FleetParams, k_max: int, use_pallas: bool = False):
     """Per-replica operating point at arrival rates `lam` (req/msec):
     (ttft, itl, rho, throughput req/msec)."""
@@ -389,12 +411,8 @@ def fleet_size(
 
     # replicas for the offered load; TPS targets replace the offered rate
     # (reference: pkg/core/allocation.go:133-141)
-    total = jnp.where(
-        params.target_tps > 0, params.target_tps / params.out_tokens, params.total_rate
-    )
-    replicas = jnp.ceil(total / rate_star).astype(jnp.int32)
-    replicas = jnp.maximum(replicas, params.min_replicas)
-    replicas = jnp.maximum(replicas, 1)
+    total = offered_load(params.total_rate, params.target_tps, params.out_tokens)
+    replicas = fold_replicas(total, rate_star, params.min_replicas)
     cost = replicas.astype(jnp.float32) * params.cost_per_replica
 
     # expected per-replica operating point
@@ -563,12 +581,8 @@ def tandem_fleet_size(
     tput_star = _tandem_eval(lam_star, params, gp, gd, solve)[3]
     rate_star = tput_star * 1000.0
 
-    total = jnp.where(
-        params.target_tps > 0, params.target_tps / params.out_tokens, params.total_rate
-    )
-    replicas = jnp.ceil(total / rate_star).astype(jnp.int32)
-    replicas = jnp.maximum(replicas, params.min_replicas)
-    replicas = jnp.maximum(replicas, 1)
+    total = offered_load(params.total_rate, params.target_tps, params.out_tokens)
+    replicas = fold_replicas(total, rate_star, params.min_replicas)
     cost = replicas.astype(jnp.float32) * params.cost_per_replica
 
     # expected per-unit operating point

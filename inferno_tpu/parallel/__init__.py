@@ -1,4 +1,5 @@
 from inferno_tpu.parallel.fleet import (
+    FleetBatchResult,
     FleetCandidates,
     FleetPlan,
     LaneAllocations,
@@ -6,6 +7,7 @@ from inferno_tpu.parallel.fleet import (
     build_fleet,
     build_tandem_fleet,
     calculate_fleet,
+    calculate_fleet_batch,
     reset_fleet_state,
     solve_fleet,
     solve_tandem_fleet,
@@ -13,6 +15,7 @@ from inferno_tpu.parallel.fleet import (
 from inferno_tpu.parallel.mesh import fleet_mesh, shard_fleet_params
 
 __all__ = [
+    "FleetBatchResult",
     "FleetCandidates",
     "FleetPlan",
     "LaneAllocations",
@@ -20,6 +23,7 @@ __all__ = [
     "build_fleet",
     "build_tandem_fleet",
     "calculate_fleet",
+    "calculate_fleet_batch",
     "reset_fleet_state",
     "solve_fleet",
     "solve_tandem_fleet",

@@ -34,6 +34,8 @@ from inferno_tpu.ops.queueing import (
     FleetParams,
     FleetResult,
     TandemParams,
+    fold_replicas,
+    offered_load,
     unpack_result,
 )
 from inferno_tpu.parallel.mesh import fleet_mesh, shard_fleet_params
@@ -542,6 +544,68 @@ def solve_tandem_fleet(
 _solve_memo: dict = {}
 
 
+def _solve_or_replay(
+    plan: FleetPlan | None,
+    tandem: TandemPlan | None,
+    mesh: jax.sharding.Mesh | None,
+    backend: str,
+) -> tuple[FleetResult | None, FleetResult | None]:
+    """Solve both plans through the selected backend, replaying the
+    previous results when the exact plan OBJECTS repeat (see _solve_memo).
+    Shared by the per-cycle `calculate_fleet` and the time-axis
+    `calculate_fleet_batch` — a replay scenario re-run on an unchanged
+    fleet skips the device round trip entirely."""
+    memo = _solve_memo.get("last")
+    if (
+        memo is not None
+        and memo["backend"] == backend
+        and memo["mesh"] is mesh
+        and memo["plan"] is plan
+        and memo["tandem"] is tandem
+    ):
+        return memo["results"]
+    if backend == "native":
+        # the C++ solver covers both lane kinds: no device runtime
+        # and no XLA compilation on this path (jax stays a host-only
+        # import)
+        from inferno_tpu.native import fleet_size_native, tandem_size_native
+
+        result = fleet_size_native(plan.params) if plan is not None else None
+        tresult = tandem_size_native(tandem.params) if tandem is not None else None
+    else:
+        result, tresult = _solve_all(
+            plan, tandem, mesh, DEFAULT_BISECT_ITERS, backend == "tpu-pallas"
+        )
+    _solve_memo["last"] = {
+        "backend": backend, "mesh": mesh, "plan": plan,
+        "tandem": tandem, "results": (result, tresult),
+    }
+    return result, tresult
+
+
+def _lane_orders(system: System, names: list[str], acc_order: dict, p):
+    """(server_idx, acc_rank, chips_per_replica) per lane of a plan:
+    snapshot-packed plans carry them; legacy-built plans (FLEET_SNAPSHOT=0)
+    derive all three from the lane list."""
+    if (
+        p.server_idx is not None
+        and p.acc_rank is not None
+        and p.chips_per_replica is not None
+    ):
+        # snapshot-packed, version-safe
+        return p.server_idx, p.acc_rank, p.chips_per_replica
+    spos = {name: i for i, name in enumerate(names)}
+    chips = np.empty(len(p.lanes), np.int64)
+    for i, (s, a) in enumerate(p.lanes):
+        model = system.models.get(system.servers[s].model_name)
+        chips[i] = model.slices_per_replica(a) * system.accelerators[a].chips
+    return (
+        np.asarray([spos[s] for s, _ in p.lanes], np.int64),
+        np.asarray([acc_order[a] for _, a in p.lanes], np.int64),
+        chips,
+    )
+
+
 class _LaneSource:
     """Per-cycle context the lazy allocations materialize from: the solved
     plans/results plus the vectorized f64 transition-penalty values (bit
@@ -771,34 +835,7 @@ def calculate_fleet(
     # the memo holds strong refs to the exact plan objects it solved, so
     # `is` identity (not id()) is the content check — a replayed plan is
     # the same object from _plan_memo, a rebuilt one never matches
-    memo = _solve_memo.get("last")
-    if (
-        memo is not None
-        and memo["backend"] == backend
-        and memo["mesh"] is mesh
-        and memo["plan"] is plan
-        and memo["tandem"] is tandem
-    ):
-        result, tresult = memo["results"]
-    else:
-        if backend == "native":
-            # the C++ solver covers both lane kinds: no device runtime
-            # and no XLA compilation on this path (jax stays a host-only
-            # import)
-            from inferno_tpu.native import fleet_size_native, tandem_size_native
-
-            result = fleet_size_native(plan.params) if plan is not None else None
-            tresult = (
-                tandem_size_native(tandem.params) if tandem is not None else None
-            )
-        else:
-            result, tresult = _solve_all(
-                plan, tandem, mesh, DEFAULT_BISECT_ITERS, backend == "tpu-pallas"
-            )
-        _solve_memo["last"] = {
-            "backend": backend, "mesh": mesh, "plan": plan,
-            "tandem": tandem, "results": (result, tresult),
-        }
+    result, tresult = _solve_or_replay(plan, tandem, mesh, backend)
 
     # -- vectorized writeback: per-lane transition penalties, per-server
     # candidate argmin, lazy Allocation views -------------------------------
@@ -815,28 +852,6 @@ def calculate_fleet(
         cur_cost[i] = cur.cost
         cur_reps[i] = cur.num_replicas
 
-    def lane_orders(p):
-        if (
-            p.server_idx is not None
-            and p.acc_rank is not None
-            and p.chips_per_replica is not None
-        ):
-            # snapshot-packed, version-safe
-            return p.server_idx, p.acc_rank, p.chips_per_replica
-        # legacy-built plan (FLEET_SNAPSHOT=0): derive from the lane list
-        spos = {name: i for i, name in enumerate(names)}
-        chips = np.empty(len(p.lanes), np.int64)
-        for i, (s, a) in enumerate(p.lanes):
-            model = system.models.get(system.servers[s].model_name)
-            chips[i] = (
-                model.slices_per_replica(a) * system.accelerators[a].chips
-            )
-        return (
-            np.asarray([spos[s] for s, _ in p.lanes], np.int64),
-            np.asarray([acc_order[a] for _, a in p.lanes], np.int64),
-            chips,
-        )
-
     n = 0
     src = _LaneSource()
     # (sidx, rank, value, cost, reps, chips, kind, lane) per feasible lane
@@ -849,7 +864,7 @@ def calculate_fleet(
         kinds.append((1, tandem, tresult, np.asarray(tandem.params.decode_batch)))
         n += tandem.num_lanes
     for kind_id, p, res, batches in kinds:
-        sidx, rank, chips = lane_orders(p)
+        sidx, rank, chips = _lane_orders(system, names, acc_order, p)
         cost64 = np.asarray(res.cost, np.float64)
         reps = np.asarray(res.num_replicas, np.int64)
         same_acc = rank == cur_rank[sidx]
@@ -911,3 +926,298 @@ def calculate_fleet(
         seg_server=s_sorted[starts],
     )
     return n
+
+
+# -- batched time-axis solve (the offline planner's replay core) --------------
+
+
+@dataclasses.dataclass
+class FleetBatchResult:
+    """Compact per-timestep solve outputs of `calculate_fleet_batch`:
+    [T, servers] arrays, NO per-timestep Allocation/LaneAllocations
+    materialization. `choice[t, s]` indexes `accelerators` (the sorted
+    catalog, i.e. the tie-break rank axis); -1 means the server holds no
+    slice at that timestep (no feasible candidate, or the zero-load
+    shortcut with min_replicas == 0)."""
+
+    servers: list[str]  # system server order (the S axis)
+    accelerators: list[str]  # sorted catalog (choice indexes this)
+    choice: np.ndarray  # i32[T, S]
+    replicas: np.ndarray  # i32[T, S]
+    chips: np.ndarray  # i64[T, S]: whole-slice chip demand
+    cost: np.ndarray  # f32[T, S]: cents/hr
+    value: np.ndarray  # f64[T, S]: winner transition penalty
+
+    @property
+    def num_steps(self) -> int:
+        return len(self.choice)
+
+
+def _batch_chunk_steps(requested: int | None, n_lanes: int) -> int:
+    """Time-axis chunk size: how many timesteps' [T_chunk, lanes] fold
+    tensors are resident at once. PLANNER_CHUNK_STEPS (env) or the
+    `chunk_steps` argument pin it; the default bounds the slab to ~2 M
+    lane-rows — with the ~8 live fold/argmin temporaries (f64/i64/f32,
+    ~50 bytes per row all told) that's a ~100 MB peak regardless of
+    fleet size."""
+    if requested is None:
+        import os
+
+        env = os.environ.get("PLANNER_CHUNK_STEPS", "").strip()
+        requested = int(env) if env else 0
+    if requested > 0:
+        return requested
+    return max(1, 2_000_000 // max(n_lanes, 1))
+
+
+def calculate_fleet_batch(
+    system: System,
+    rates,
+    mesh: jax.sharding.Mesh | None = None,
+    use_mesh: bool = False,
+    backend: str = "tpu",
+    chunk_steps: int | None = None,
+) -> FleetBatchResult:
+    """Solve T timesteps of per-server arrival rates in one pass: the
+    batched time-axis equivalent of the serial loop
+
+        for t in range(T):
+            <set server.load.arrival_rate = rates[t]>; calculate_fleet(...)
+            solve_unlimited(...)
+
+    with bit-identical choices, replica counts, and chip demand
+    (tests/test_planner.py pins T=1 and multi-T parity), at a fraction of
+    the cost. `rates` is [T, S] in req/min, S = the system's server order;
+    per-timestep rates REPLACE each server's arrival rate, token mix and
+    everything structural stay as carried by the System.
+
+    Why this is cheap: the snapshot's structure signatures are
+    load-independent, so the T-step replay pays lane derivation and plan
+    packing exactly ONCE; and the sizing bisection itself is
+    rate-independent (lambda*, per-replica capacity, and feasibility
+    depend only on profiles and SLO targets), so the jitted grid solve is
+    hoisted out of the time axis entirely. Per timestep only the replica
+    fold (`ops.queueing.fold_replicas`, the exact f32 arithmetic of the
+    jitted program), the f64 transition penalties, and the per-server
+    (value, cost, rank) argmin run — vectorized numpy over
+    [T_chunk, lanes] slabs (`chunk_steps` / PLANNER_CHUNK_STEPS bounds
+    the resident slab; chunk placement never changes results). Zero-rate
+    timesteps take the closed-form zero-load shortcut, precomputed once
+    per server.
+    """
+    rates = np.asarray(rates, np.float64)
+    names = list(system.servers)
+    if rates.ndim != 2 or rates.shape[1] != len(names):
+        raise ValueError(
+            f"rates must be [T, {len(names)}] (system server order), "
+            f"got {rates.shape}"
+        )
+    if not np.all(np.isfinite(rates)) or (rates < 0).any():
+        raise ValueError("rates must be finite and >= 0")
+    if use_mesh and mesh is None:
+        mesh = fleet_mesh()
+    servers_list = list(system.servers.values())
+    acc_names = sorted(system.accelerators)
+    acc_order = {a: i for i, a in enumerate(acc_names)}
+    n_steps, n_srv = rates.shape
+
+    # current-allocation columns: the transition-penalty basis, identical
+    # to the per-cycle writeback's
+    cur_rank = np.full(n_srv, -1, np.int64)
+    cur_cost = np.zeros(n_srv, np.float64)
+    cur_reps = np.full(n_srv, -1, np.int64)
+    for i, server in enumerate(servers_list):
+        cur = server.cur_allocation
+        if cur.accelerator:
+            cur_rank[i] = acc_order.get(cur.accelerator, -1)
+        cur_cost[i] = cur.cost
+        cur_reps[i] = cur.num_replicas
+
+    # zero-load shortcut, precomputed once per server: the per-timestep
+    # rate replaces the arrival rate, so any server can hit rate == 0 at
+    # some timestep. Mirrors calculate_fleet's shortcut loop + the
+    # solve_unlimited (value, cost, accelerator) scan. The O(servers x
+    # accelerators) scalar walk only runs when some timestep can actually
+    # use it — an all-positive trace (the common planner case) skips it.
+    zero_choice = np.full(n_srv, -1, np.int32)
+    zero_reps = np.zeros(n_srv, np.int32)
+    zero_chips = np.zeros(n_srv, np.int64)
+    zero_cost = np.zeros(n_srv, np.float32)
+    zero_value = np.zeros(n_srv, np.float64)
+    has_load = np.zeros(n_srv, bool)
+    out_zero = np.zeros(n_srv, bool)
+    for i, server in enumerate(servers_list):
+        load = server.load
+        if load is None:
+            continue
+        has_load[i] = True
+        out_zero[i] = load.avg_out_tokens == 0
+    # load-less servers' all-zero rate columns don't need the table (the
+    # overlay ANDs with has_load), so they must not defeat the gate
+    if bool(out_zero.any()) or bool(((rates == 0.0) & has_load[None, :]).any()):
+        for i, server in enumerate(servers_list):
+            if not has_load[i]:
+                continue
+            model = system.models.get(server.model_name)
+            svc = system.service_classes.get(server.service_class_name)
+            if (
+                model is None
+                or svc is None
+                or svc.target_for(server.model_name) is None
+            ):
+                continue
+            best = best_key = None
+            for acc in server.candidate_accelerators(system).values():
+                perf = model.perf_data.get(acc.name)
+                if perf is None:
+                    continue
+                alloc = _zero_load_allocation(server, model, acc, perf)
+                alloc.value = transition_penalty(server.cur_allocation, alloc)
+                key = (alloc.value, alloc.cost, alloc.accelerator)
+                if best is None or key < best_key:
+                    best, best_key = alloc, key
+            if best is not None and best.accelerator:
+                zero_choice[i] = acc_order[best.accelerator]
+                zero_reps[i] = best.num_replicas
+                zero_chips[i] = best.num_replicas * model.slices_per_replica(
+                    best.accelerator
+                ) * system.accelerators[best.accelerator].chips
+                zero_cost[i] = best.cost
+                zero_value[i] = best.value
+
+    # lane structure under a positive placeholder rate: every replayed
+    # server must contribute its token-eligible lanes regardless of the
+    # System's own arrival (rates[t] replaces it timestep by timestep).
+    # Token stats are untouched, so batch rescale / grids / eligibility
+    # beyond the arrival>0 test are exactly the per-cycle ones, and the
+    # plan + solve memos make a re-replay on an unchanged fleet free.
+    loaded = [s for s in servers_list if s.load is not None]
+    saved = [s.load.arrival_rate for s in loaded]
+    for s in loaded:
+        s.load.arrival_rate = 60.0  # 1 req/s placeholder
+    try:
+        plan = build_fleet(system)
+        tandem = build_tandem_fleet(system)
+        if plan is not None or tandem is not None:
+            result, tresult = _solve_or_replay(plan, tandem, mesh, backend)
+        else:
+            result = tresult = None
+    finally:
+        for s, r in zip(loaded, saved):
+            s.load.arrival_rate = r
+
+    choice = np.full((n_steps, n_srv), -1, np.int32)
+    replicas = np.zeros((n_steps, n_srv), np.int32)
+    chips_out = np.zeros((n_steps, n_srv), np.int64)
+    cost_out = np.zeros((n_steps, n_srv), np.float32)
+    value_out = np.zeros((n_steps, n_srv), np.float64)
+
+    # feasible-lane columns (feasibility is rate-independent), concatenated
+    # across kinds and grouped per server for the segment argmin
+    cols: list[tuple[np.ndarray, ...]] = []
+    for p, res in ((plan, result), (tandem, tresult)):
+        if p is None or res is None or not p.num_lanes:
+            continue
+        sidx, rank, chips = _lane_orders(system, names, acc_order, p)
+        fe = np.asarray(res.feasible, bool)
+        if not fe.any():
+            continue
+        cols.append((
+            sidx[fe],
+            np.asarray(rank, np.int64)[fe],
+            np.asarray(chips, np.int64)[fe],
+            np.asarray(res.rate_star, np.float32)[fe],
+            np.asarray(p.params.target_tps, np.float32)[fe],
+            np.asarray(p.params.out_tokens, np.float32)[fe],
+            np.asarray(p.params.min_replicas, np.int32)[fe],
+            np.asarray(p.params.cost_per_replica, np.float32)[fe],
+        ))
+    if cols:
+        (
+            l_sidx, l_rank, l_chips, l_rate_star,
+            l_tps, l_out, l_min_reps, l_cpr,
+        ) = (np.concatenate(parts) for parts in zip(*cols))
+        order = np.argsort(l_sidx, kind="stable")
+        l_sidx, l_rank, l_chips = l_sidx[order], l_rank[order], l_chips[order]
+        l_rate_star, l_tps, l_out = l_rate_star[order], l_tps[order], l_out[order]
+        l_min_reps, l_cpr = l_min_reps[order], l_cpr[order]
+        n_lanes = len(l_sidx)
+        starts = np.flatnonzero(np.r_[True, l_sidx[1:] != l_sidx[:-1]])
+        seg_len = np.diff(np.append(starts, n_lanes))
+        seg_server = l_sidx[starts]
+        l_same = l_rank == cur_rank[l_sidx]
+        l_ccost = cur_cost[l_sidx]
+        l_creps = cur_reps[l_sidx]
+        lane_pos = np.arange(n_lanes, dtype=np.int64)
+    else:
+        n_lanes = 0
+
+    chunk = _batch_chunk_steps(chunk_steps, n_lanes)
+    for t0 in range(0, n_steps, chunk):
+        r = rates[t0 : t0 + chunk]  # [Tc, S]
+        t1 = t0 + len(r)
+        if n_lanes:
+            # the replica fold: the identical f32 arithmetic the jitted
+            # fleet_size/tandem_fleet_size programs run per lane
+            # (offered_load/fold_replicas shared with the kernels; lanes
+            # in the table always have out_tokens > 0)
+            total = (r / 60.0).astype(np.float32)[:, l_sidx]  # [Tc, L]
+            total = offered_load(total, l_tps, l_out, np)
+            reps = fold_replicas(total, l_rate_star, l_min_reps, np)
+            cost32 = reps.astype(np.float32) * l_cpr
+            cost64 = cost32.astype(np.float64)
+            # transition_penalty(), same f64 op order as the writeback
+            value = np.where(
+                l_same & (reps == l_creps),
+                0.0,
+                np.where(
+                    l_same,
+                    cost64 - l_ccost,
+                    ACCEL_PENALTY_FACTOR * (l_ccost + cost64) + (cost64 - l_ccost),
+                ),
+            )
+            # per-server lexicographic argmin on (value, cost, rank) —
+            # the (value, cost, accelerator) key of solve_unlimited and
+            # the per-cycle lexsort, over the whole chunk at once
+            m = np.minimum.reduceat(value, starts, axis=1)
+            tie = value == np.repeat(m, seg_len, axis=1)
+            c_m = np.where(tie, cost64, np.inf)
+            m2 = np.minimum.reduceat(c_m, starts, axis=1)
+            tie &= c_m == np.repeat(m2, seg_len, axis=1)
+            r_m = np.where(tie, l_rank, np.int64(2**62))
+            m3 = np.minimum.reduceat(r_m, starts, axis=1)
+            # rank is unique per server segment => exactly one winner
+            win_lane = np.where(
+                r_m == np.repeat(m3, seg_len, axis=1), lane_pos, np.int64(n_lanes)
+            )
+            win = np.minimum.reduceat(win_lane, starts, axis=1)  # [Tc, segs]
+            reps_w = np.take_along_axis(reps, win, axis=1)
+            choice[t0:t1, seg_server] = l_rank[win].astype(np.int32)
+            replicas[t0:t1, seg_server] = reps_w
+            chips_out[t0:t1, seg_server] = reps_w.astype(np.int64) * l_chips[win]
+            cost_out[t0:t1, seg_server] = np.take_along_axis(cost32, win, axis=1)
+            value_out[t0:t1, seg_server] = np.take_along_axis(value, win, axis=1)
+        # zero-load shortcut overlay: rate == 0 (or out_tokens == 0, which
+        # shortcuts regardless of rate) replaces the sized pick
+        zmask = ((r == 0.0) | out_zero[None, :]) & has_load[None, :]
+        if zmask.any():
+            np.copyto(choice[t0:t1], np.broadcast_to(zero_choice, r.shape),
+                      where=zmask)
+            np.copyto(replicas[t0:t1], np.broadcast_to(zero_reps, r.shape),
+                      where=zmask)
+            np.copyto(chips_out[t0:t1], np.broadcast_to(zero_chips, r.shape),
+                      where=zmask)
+            np.copyto(cost_out[t0:t1], np.broadcast_to(zero_cost, r.shape),
+                      where=zmask)
+            np.copyto(value_out[t0:t1], np.broadcast_to(zero_value, r.shape),
+                      where=zmask)
+
+    return FleetBatchResult(
+        servers=names,
+        accelerators=acc_names,
+        choice=choice,
+        replicas=replicas,
+        chips=chips_out,
+        cost=cost_out,
+        value=value_out,
+    )

@@ -1,0 +1,43 @@
+"""Offline fleet capacity planner (ROADMAP item 3).
+
+Replays millions-of-users traffic scenarios — diurnal cycles, regional
+skews, flash crowds, new-model launches, fleet-wide growth ramps —
+through the batched time-axis sizing solve
+(`parallel.fleet.calculate_fleet_batch`: one pass for a whole quarter of
+timesteps, bit-identical to the per-cycle solve) and answers "how many
+chips of which generation, and when does each pool first bind" with
+per-pool peak/p95 chip demand, violation-seconds, first-bind timestamps
+under the PR 7 quota buckets, and $-cost bands per scenario.
+
+CLI: ``python -m inferno_tpu.planner --help`` (see docs/performance.md
+"Batched time-axis replay"). Library entry points:
+
+* `scenarios.build_scenarios` / the individual generators — seeded,
+  deterministic [T, S] rate traces;
+* `replay.replay_scenario` — one scenario through the batched solve,
+  aggregated; `forecast=True` adds the forecast-bound sizing pass;
+* `replay.aggregate_replay` — the aggregation alone, for callers that
+  already hold a `FleetBatchResult`.
+"""
+
+from inferno_tpu.planner.replay import (
+    aggregate_replay,
+    forecast_bound_rates,
+    replay_scenario,
+)
+from inferno_tpu.planner.scenarios import (
+    GENERATORS,
+    ScenarioTrace,
+    base_rates_from_system,
+    build_scenarios,
+)
+
+__all__ = [
+    "GENERATORS",
+    "ScenarioTrace",
+    "aggregate_replay",
+    "base_rates_from_system",
+    "build_scenarios",
+    "forecast_bound_rates",
+    "replay_scenario",
+]

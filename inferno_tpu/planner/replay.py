@@ -1,0 +1,302 @@
+"""Scenario replay + aggregation for the offline capacity planner.
+
+`replay_scenario` drives one `ScenarioTrace` through the batched
+time-axis solve (`parallel.fleet.calculate_fleet_batch` — one pass for
+all T timesteps, no per-timestep allocation churn) and aggregates the
+compact [T, servers] choice/replica/chip arrays into the planner's
+answers:
+
+* per-pool (generation) and per-quota-bucket chip-demand time series
+  with peak / p95 / mean, using the exact bucket addressing of the
+  capacity-constrained greedy (`solver.greedy_vec.capacity_buckets`);
+* **first-bind timestamps**: the first timestep each configured pool or
+  quota bucket's aggregate demand exceeds its budget. A pool with no
+  configured budget (`System.capacity` has no entry) cannot bind and is
+  reported demand-only — the planner's question for it is "how many
+  chips WOULD I need", not "when do I run out";
+* a **degradation estimate** for binding timesteps: servers fill their
+  buckets in (priority asc, transition-value desc) order — the greedy's
+  group order without the per-step regret reshuffling — and whoever
+  doesn't fit counts as zeroed. This is an aggregate upper bound: the
+  live solver would first walk the shape -> int8 -> replica ladder
+  before zeroing, so the report names it `zeroed_upper_bound`;
+* `violation_seconds` = sum over timesteps of step_seconds x the number
+  of variants zeroed at that timestep;
+* $-cost bands (p5/p50/p95/peak of the per-timestep fleet cost) and the
+  horizon's total spend.
+
+`forecast=True` additionally replays the scenario with every rate
+replaced by max(observed, forecast upper band) — the reconciler's
+forecast-bound sizing rule (`forecast.ArrivalForecaster`) applied
+offline — so reactive vs forecast-bound capacity needs sit side by side
+in one report.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from inferno_tpu.parallel.fleet import FleetBatchResult, calculate_fleet_batch
+from inferno_tpu.planner.scenarios import ScenarioTrace
+from inferno_tpu.solver.greedy_vec import capacity_buckets
+
+
+def forecast_bound_rates(
+    rates: np.ndarray,
+    step_seconds: float,
+    horizon_s: float,
+    config=None,
+) -> np.ndarray:
+    """The reconciler's forecast-bound sizing rule applied to a whole
+    trace: each server's rate at step t becomes
+    max(observed, forecast(horizon).upper) with the forecaster having
+    seen the observations up to and including t. O(T x S) filter steps —
+    offline-planner cost, not cycle cost."""
+    from inferno_tpu.forecast import ArrivalForecaster
+
+    rates = np.asarray(rates, np.float64)
+    eff = rates.copy()
+    forecaster = ArrivalForecaster(config)
+    n_steps, n_srv = rates.shape
+    for s in range(n_srv):
+        key = f"s{s}"
+        for t in range(n_steps):
+            forecaster.observe(key, t * step_seconds, float(rates[t, s]))
+            fc = forecaster.forecast(key, horizon_s)
+            if fc.valid and fc.upper > eff[t, s]:
+                eff[t, s] = fc.upper
+    return eff
+
+
+def _series_stats(series: np.ndarray, include_series: bool) -> dict:
+    out = {
+        "peak": float(series.max(initial=0.0)),
+        "p95": float(np.percentile(series, 95.0)) if len(series) else 0.0,
+        "mean": float(series.mean()) if len(series) else 0.0,
+    }
+    if include_series:
+        out["series"] = [float(v) for v in series]
+    return out
+
+
+def _bucket_demand(
+    result: FleetBatchResult, bucket_of_rank: np.ndarray, n_buckets: int
+) -> np.ndarray:
+    """[T, n_buckets] chip demand: each timestep's winner chips summed by
+    the bucket their accelerator rank maps to (-1 = no bucket)."""
+    n_steps = result.num_steps
+    if n_buckets == 0 or n_steps == 0:
+        return np.zeros((n_steps, n_buckets), np.float64)
+    valid = result.choice >= 0
+    bucket = np.where(valid, bucket_of_rank[np.maximum(result.choice, 0)], -1)
+    ok = bucket >= 0
+    t_idx = np.broadcast_to(
+        np.arange(n_steps, dtype=np.int64)[:, None], bucket.shape
+    )
+    flat = t_idx[ok] * n_buckets + bucket[ok]
+    counts = np.bincount(
+        flat, weights=result.chips[ok].astype(np.float64),
+        minlength=n_steps * n_buckets,
+    )
+    return counts.reshape(n_steps, n_buckets)
+
+
+def _first_bind(demand: np.ndarray, budget: float, step_seconds: float):
+    over = np.flatnonzero(demand > budget)
+    if not len(over):
+        return None, None
+    t = int(over[0])
+    return t, t * step_seconds
+
+
+def aggregate_replay(
+    system,
+    result: FleetBatchResult,
+    step_seconds: float,
+    include_series: bool = False,
+) -> dict:
+    """Fold one replay's [T, S] arrays into the planner report block (see
+    module docstring for the field semantics)."""
+    ledger = capacity_buckets(system)
+    n_steps = result.num_steps
+    configured_pools = set(system.capacity)
+
+    pool_demand = _bucket_demand(result, ledger.rank_pid, len(ledger.pools))
+    pools = {}
+    for i, pool in enumerate(ledger.pools):
+        block = _series_stats(pool_demand[:, i], include_series)
+        if pool in configured_pools:
+            budget = float(ledger.pool_remaining[i])
+            block["budget_chips"] = budget
+            t, at_s = _first_bind(pool_demand[:, i], budget, step_seconds)
+            block["first_bind_step"] = t
+            block["first_bind_at_s"] = at_s
+        pools[pool] = block
+
+    quota_demand = np.zeros((n_steps, len(ledger.quota_keys)), np.float64)
+    for qmap in (ledger.rank_q1, ledger.rank_q2):
+        quota_demand += _bucket_demand(result, qmap, len(ledger.quota_keys))
+    quotas = {}
+    for i, key in enumerate(ledger.quota_keys):
+        block = _series_stats(quota_demand[:, i], include_series)
+        budget = float(ledger.quota_remaining[i])
+        block["budget_chips"] = budget
+        t, at_s = _first_bind(quota_demand[:, i], budget, step_seconds)
+        block["first_bind_step"] = t
+        block["first_bind_at_s"] = at_s
+        quotas[key] = block
+
+    # binding timesteps: any configured bucket over budget
+    binding = np.zeros(n_steps, bool)
+    for i, pool in enumerate(ledger.pools):
+        if pool in configured_pools:
+            binding |= pool_demand[:, i] > float(ledger.pool_remaining[i])
+    for i in range(len(ledger.quota_keys)):
+        binding |= quota_demand[:, i] > float(ledger.quota_remaining[i])
+
+    prio = np.asarray(
+        [s.priority(system) for s in system.servers.values()], np.int64
+    )
+    zeroed_steps = np.zeros(n_steps, np.int64)
+    zeroed_by_prio: dict[int, int] = {}
+    first_zero_step = None
+    configured_pid = np.asarray(
+        [p in configured_pools for p in ledger.pools], bool
+    )
+    pool_budget = ledger.pool_remaining.astype(np.float64)
+    quota_budget = ledger.quota_remaining.astype(np.float64)
+    for t in np.flatnonzero(binding):
+        # only buckets OVER budget at t can zero anyone: demand in a
+        # non-binding bucket fits in any fill order, so servers drawing
+        # exclusively from non-binding buckets are skipped and only the
+        # binding buckets' budgets are tracked — same outcome as filling
+        # everything, at the contested subset's cost
+        pool_bind = configured_pid & (pool_demand[t] > pool_budget)
+        quota_bind = quota_demand[t] > quota_budget
+        choice_t = result.choice[t]
+        demand_t = result.chips[t]
+        valid = (choice_t >= 0) & (demand_t > 0)
+        rank_t = np.maximum(choice_t, 0)
+        q1_t, q2_t = ledger.rank_q1[rank_t], ledger.rank_q2[rank_t]
+
+        def quota_hit(q):
+            if not len(quota_bind):  # no quota buckets configured
+                return False
+            return (q >= 0) & quota_bind[np.maximum(q, 0)]
+
+        contested = valid & (
+            pool_bind[ledger.rank_pid[rank_t]]
+            | quota_hit(q1_t)
+            | quota_hit(q2_t)
+        )
+        active = np.flatnonzero(contested)
+        if not len(active):
+            continue
+        order = active[np.lexsort((-result.value[t, active], prio[active]))]
+        # scalar fill over plain Python ints/floats (numpy-scalar
+        # indexing per element is ~10x slower at 10k-variant scale)
+        needs = demand_t[order].astype(np.float64).tolist()
+        pids = ledger.rank_pid[rank_t[order]].tolist()
+        q1s = q1_t[order].tolist()
+        q2s = q2_t[order].tolist()
+        prios = prio[order].tolist()
+        pbind = pool_bind.tolist()
+        qbind = quota_bind.tolist()
+        prem = pool_budget.tolist()
+        qrem = quota_budget.tolist()
+        for k in range(len(order)):
+            need, pid, q1, q2 = needs[k], pids[k], q1s[k], q2s[k]
+            fits = not pbind[pid] or prem[pid] >= need
+            if fits and q1 >= 0 and qbind[q1]:
+                fits = qrem[q1] >= need
+            if fits and q2 >= 0 and qbind[q2]:
+                fits = qrem[q2] >= need
+            if fits:
+                if pbind[pid]:
+                    prem[pid] -= need
+                if q1 >= 0 and qbind[q1]:
+                    qrem[q1] -= need
+                if q2 >= 0 and qbind[q2]:
+                    qrem[q2] -= need
+            else:
+                zeroed_steps[t] += 1
+                p = prios[k]
+                zeroed_by_prio[p] = zeroed_by_prio.get(p, 0) + 1
+                if first_zero_step is None:
+                    first_zero_step = int(t)
+
+    cost_usd_hr = result.cost.astype(np.float64).sum(axis=1) / 100.0
+    cost = {
+        "mean_usd_per_hr": float(cost_usd_hr.mean()) if n_steps else 0.0,
+        "p5_usd_per_hr": float(np.percentile(cost_usd_hr, 5.0)) if n_steps else 0.0,
+        "p50_usd_per_hr": float(np.percentile(cost_usd_hr, 50.0)) if n_steps else 0.0,
+        "p95_usd_per_hr": float(np.percentile(cost_usd_hr, 95.0)) if n_steps else 0.0,
+        "peak_usd_per_hr": float(cost_usd_hr.max(initial=0.0)),
+        "total_usd": float(cost_usd_hr.sum() * step_seconds / 3600.0),
+    }
+    if include_series:
+        cost["series_usd_per_hr"] = [float(v) for v in cost_usd_hr]
+
+    return {
+        "pools": pools,
+        "quotas": quotas,
+        "binding_steps": int(binding.sum()),
+        "violation_seconds": float(zeroed_steps.sum() * step_seconds),
+        "zeroed_upper_bound": {
+            "variant_steps": int(zeroed_steps.sum()),
+            "peak_concurrent": int(zeroed_steps.max(initial=0)),
+            "first_zero_step": first_zero_step,
+            "by_priority": {
+                str(k): v for k, v in sorted(zeroed_by_prio.items())
+            },
+            "note": (
+                "aggregate fill in (priority, -value) order, no shape/"
+                "replica step-down modeled — an upper bound on what the "
+                "degradation ladder would zero"
+            ),
+        },
+        "cost": cost,
+    }
+
+
+def replay_scenario(
+    system,
+    trace: ScenarioTrace,
+    backend: str = "jax",
+    chunk_steps: int | None = None,
+    include_series: bool = False,
+    forecast: bool = False,
+    forecast_horizon_s: float | None = None,
+    forecast_config=None,
+) -> dict:
+    """Replay one scenario through the batched solve; optionally a second
+    forecast-bound pass for the reactive-vs-forecast comparison."""
+    result = calculate_fleet_batch(
+        system, trace.rates, backend=backend, chunk_steps=chunk_steps
+    )
+    out = {
+        "scenario": trace.name,
+        "description": trace.description,
+        "seed": trace.seed,
+        "steps": trace.steps,
+        "step_seconds": trace.step_seconds,
+        "variants": len(result.servers),
+        "reactive": aggregate_replay(
+            system, result, trace.step_seconds, include_series
+        ),
+    }
+    if forecast:
+        horizon = (
+            trace.step_seconds if forecast_horizon_s is None else forecast_horizon_s
+        )
+        eff = forecast_bound_rates(
+            trace.rates, trace.step_seconds, horizon, forecast_config
+        )
+        bound = calculate_fleet_batch(
+            system, eff, backend=backend, chunk_steps=chunk_steps
+        )
+        out["forecast_horizon_s"] = horizon
+        out["forecast_bound"] = aggregate_replay(
+            system, bound, trace.step_seconds, include_series
+        )
+    return out

@@ -205,6 +205,16 @@ class _ArrayLedger:
         return ("", need) if rank is None else self.shortfall_rank(rank, need)
 
 
+def capacity_buckets(system: System) -> _ArrayLedger:
+    """A fresh `_ArrayLedger` for `system` — the pool budgets and quota
+    carve-outs in exactly the bucket order the capacity-constrained
+    greedy enforces. The offline planner (inferno_tpu.planner.replay)
+    feeds each timestep's aggregate chip demand through these buckets to
+    report when a pool/region first binds, using the same rank ->
+    (pool, region-quota, pool-quota) addressing as the live solve."""
+    return _ArrayLedger(system)
+
+
 def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
     """Capacity-constrained solve routed through the columnar candidate
     table when one is attached (batched sizing ran this cycle); falls

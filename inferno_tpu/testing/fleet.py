@@ -186,13 +186,22 @@ def fleet_capacity(spec, fraction: float = 1.0, backend: str = "jax") -> dict:
     return {pool: max(int(u.chips * fraction), 0) for pool, u in usage.items()}
 
 
-def perturb_loads(system, scale: float = 1.02) -> None:
+def perturb_loads(system, scale: float = 1.02, rng=None, spread: float = 0.25) -> None:
     """Scale every loaded server's arrival rate in place — the cheapest
     'every variant changed' cycle input (defeats plan replay so repeated
-    sizing passes measure honest recompute, as a live fleet would)."""
+    sizing passes measure honest recompute, as a live fleet would).
+
+    With a seeded `rng` (np.random.Generator) each server draws its OWN
+    factor from `scale * [1 - spread, 1 + spread]` — a reproducible
+    per-variant skew (the planner's regional-skew scenario generators
+    need dispersion a uniform fixed scale can't express). `rng=None`
+    keeps the legacy uniform behavior every existing caller relies on."""
     for server in system.servers.values():
         if server.load is not None and server.load.arrival_rate > 0:
-            server.load.arrival_rate *= scale
+            factor = scale
+            if rng is not None:
+                factor *= 1.0 + spread * float(rng.uniform(-1.0, 1.0))
+            server.load.arrival_rate *= factor
 
 
 def fleet_model(i: int) -> str:

@@ -1,0 +1,397 @@
+"""Batched time-axis solve + offline planner (ISSUE-8).
+
+The serial per-timestep loop — mutate every arrival rate, run
+`calculate_fleet` + `solve_unlimited` — is the parity oracle: the
+batched `calculate_fleet_batch` must agree BIT-IDENTICALLY on choices,
+replica counts, and chip demand over the edge fleets (zero-load,
+infeasible, pinned, tandem), at T=1 and across multiple timesteps, and
+chunk-boundary placement must never change results. Everything here is
+CPU-jax, fast tier, deterministic.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from inferno_tpu.core import System
+from inferno_tpu.parallel import (
+    calculate_fleet,
+    calculate_fleet_batch,
+    reset_fleet_state,
+)
+from inferno_tpu.solver.solver import solve_unlimited
+from inferno_tpu.testing.fleet import fleet_system_spec, perturb_loads
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_state():
+    reset_fleet_state()
+    yield
+    reset_fleet_state()
+
+
+def _acc_index(system):
+    return {a: i for i, a in enumerate(sorted(system.accelerators))}
+
+
+def _serial_rows(system):
+    """(choice, replicas, chips) per server from the solved system — the
+    serial loop's answer in the batch result's encoding."""
+    acc_idx = _acc_index(system)
+    rows = []
+    for server in system.servers.values():
+        a = server.allocation
+        if a is None or not a.accelerator:
+            rows.append((-1, 0, 0))
+            continue
+        model = system.models[server.model_name]
+        chips = (
+            a.num_replicas
+            * model.slices_per_replica(a.accelerator)
+            * system.accelerators[a.accelerator].chips
+        )
+        rows.append((acc_idx[a.accelerator], a.num_replicas, chips))
+    return rows
+
+
+def _batch_rows(batch, t):
+    return [
+        (int(batch.choice[t, j]), int(batch.replicas[t, j]), int(batch.chips[t, j]))
+        for j in range(len(batch.servers))
+    ]
+
+
+def _base_rates(system):
+    return np.asarray(
+        [
+            s.load.arrival_rate if s.load is not None else 0.0
+            for s in system.servers.values()
+        ],
+        np.float64,
+    )
+
+
+def test_batch_t1_bit_identical_over_edge_fleet():
+    """T=1 at the fleet's own loads — zero-load shortcut, infeasible
+    SLOs, pinned shapes, tandem lanes all in one fixture — must equal
+    the per-cycle `calculate_fleet` + `solve_unlimited` exactly."""
+    spec = fleet_system_spec(40, shapes_per_variant=3)
+    system = System(spec)
+    rates = _base_rates(system)[None, :]
+    saved = rates.copy()
+    batch = calculate_fleet_batch(system, rates, backend="jax")
+    # the replay must leave the system's own loads untouched
+    np.testing.assert_array_equal(_base_rates(system)[None, :], saved)
+
+    reset_fleet_state()
+    oracle = System(spec)
+    calculate_fleet(oracle, backend="jax")
+    solve_unlimited(oracle)
+    assert _batch_rows(batch, 0) == _serial_rows(oracle)
+
+
+def test_batch_matches_serial_loop_across_timesteps():
+    """Multi-T parity, zero-rate timesteps included: the batch arrays
+    must be bit-identical to T independent serial passes."""
+    spec = fleet_system_spec(25, shapes_per_variant=2)
+    system = System(spec)
+    rng = np.random.default_rng(7)
+    base = _base_rates(system)
+    rates = base[None, :] * rng.uniform(0.0, 2.5, size=(6, len(base)))
+    rates[rates < 20.0] = 0.0  # force zero-load shortcut timesteps
+    batch = calculate_fleet_batch(system, rates, backend="jax")
+
+    reset_fleet_state()
+    oracle = System(spec)
+    for t in range(len(rates)):
+        for j, server in enumerate(oracle.servers.values()):
+            if server.load is not None:
+                server.load.arrival_rate = float(rates[t, j])
+        calculate_fleet(oracle, backend="jax")
+        solve_unlimited(oracle)
+        assert _batch_rows(batch, t) == _serial_rows(oracle), f"timestep {t}"
+
+
+def test_chunk_boundary_placement_never_changes_results():
+    """T_chunk in {1, 3, T} (argument and PLANNER_CHUNK_STEPS env alike)
+    must produce identical arrays — chunking is a memory bound, not a
+    semantic."""
+    spec = fleet_system_spec(20, shapes_per_variant=2)
+    system = System(spec)
+    rng = np.random.default_rng(3)
+    rates = _base_rates(system)[None, :] * rng.uniform(
+        0.2, 2.0, size=(7, len(system.servers))
+    )
+    full = calculate_fleet_batch(system, rates, backend="jax", chunk_steps=7)
+    for chunk in (1, 3):
+        other = calculate_fleet_batch(
+            system, rates, backend="jax", chunk_steps=chunk
+        )
+        for field in ("choice", "replicas", "chips", "cost", "value"):
+            np.testing.assert_array_equal(
+                getattr(full, field), getattr(other, field), err_msg=field
+            )
+
+
+def test_chunk_env_knob(monkeypatch):
+    spec = fleet_system_spec(8, shapes_per_variant=1)
+    system = System(spec)
+    rates = _base_rates(system)[None, :] * np.ones((4, 1))
+    baseline = calculate_fleet_batch(system, rates, backend="jax")
+    monkeypatch.setenv("PLANNER_CHUNK_STEPS", "2")
+    enved = calculate_fleet_batch(system, rates, backend="jax")
+    np.testing.assert_array_equal(baseline.choice, enved.choice)
+    np.testing.assert_array_equal(baseline.replicas, enved.replicas)
+
+
+def test_batch_rejects_bad_rates():
+    system = System(fleet_system_spec(5, shapes_per_variant=1))
+    with pytest.raises(ValueError, match="server order"):
+        calculate_fleet_batch(system, np.ones((2, 3)), backend="jax")
+    with pytest.raises(ValueError, match="finite"):
+        calculate_fleet_batch(
+            system, -np.ones((1, len(system.servers))), backend="jax"
+        )
+
+
+def test_perturb_loads_rng_is_reproducible_and_dispersed():
+    # systems built from ONE spec share load objects; use a fresh spec
+    # per system so each perturbation acts on its own loads
+    def fresh():
+        return System(fleet_system_spec(12, shapes_per_variant=1))
+
+    base = _base_rates(fresh())
+    loaded = base > 0
+    a, b = fresh(), fresh()
+    perturb_loads(a, scale=1.0, rng=np.random.default_rng(42))
+    perturb_loads(b, scale=1.0, rng=np.random.default_rng(42))
+    ra, rb = _base_rates(a), _base_rates(b)
+    np.testing.assert_array_equal(ra, rb)  # seeded => bit-reproducible
+    factors = ra[loaded] / base[loaded]
+    assert len(np.unique(np.round(factors, 12))) > 1  # per-variant skew
+    assert (np.abs(factors - 1.0) <= 0.25 + 1e-9).all()  # default spread
+    # legacy behavior untouched: no rng => uniform fixed scale
+    c = fresh()
+    perturb_loads(c, scale=1.5)
+    np.testing.assert_allclose(_base_rates(c)[loaded], base[loaded] * 1.5)
+
+
+def test_rate_trace_midpoint_sampling_and_tiling():
+    from inferno_tpu.emulator.experiment import rate_trace
+    from inferno_tpu.emulator.loadgen import RateSpec
+
+    spec = RateSpec.ramp(0.0, 10.0, duration=100.0, steps=10)
+    trace = rate_trace(spec, 10, 10.0)
+    assert trace == pytest.approx(np.arange(0.5, 10.0), abs=1e-9)
+    # past the schedule's end: 0 without repeat, tiled with it
+    assert rate_trace(spec, 12, 10.0)[-1] == 0.0
+    tiled = rate_trace(spec, 12, 10.0, repeat=True)
+    assert tiled[10] == trace[0] and tiled[11] == trace[1]
+    with pytest.raises(ValueError):
+        rate_trace(spec, 5, 0.0)
+
+
+def test_scenario_generators_are_seeded_and_shaped():
+    from inferno_tpu.planner.scenarios import GENERATORS, build_scenarios
+
+    base = np.asarray([60.0, 120.0, 0.0, 240.0])
+    for name, gen in GENERATORS.items():
+        t1 = gen(base, 24, 3600.0, seed=5)
+        t2 = gen(base, 24, 3600.0, seed=5)
+        np.testing.assert_array_equal(t1.rates, t2.rates), name
+        assert t1.rates.shape == (24, 4) and (t1.rates >= 0).all(), name
+        assert t1.name == name
+        # a server without load (base 0) must stay at 0 except launches
+        if name != "launch":
+            assert (t1.rates[:, 2] == 0).all(), name
+    traces = build_scenarios([], base, 6, 3600.0, seed=1)
+    assert [t.name for t in traces] == list(GENERATORS)
+    with pytest.raises(ValueError, match="unknown scenario"):
+        build_scenarios(["nope"], base, 6, 3600.0)
+    # seed derivation is per-generator, not per-selection: the same
+    # (scenario, seed) produces the same trace whether it runs alone or
+    # alongside others — reports stay diffable across scoped reruns
+    alone = build_scenarios(["flash_crowd"], base, 6, 3600.0, seed=1)[0]
+    among = [
+        t for t in build_scenarios([], base, 6, 3600.0, seed=1)
+        if t.name == "flash_crowd"
+    ][0]
+    np.testing.assert_array_equal(alone.rates, among.rates)
+
+
+def test_replay_reports_first_bind_and_violations_under_quotas():
+    """A binding pool budget + a regional quota carve-out must surface
+    first-bind timestamps, a zeroed upper bound honoring priority order,
+    violation-seconds, and cost bands."""
+    from inferno_tpu.config.types import CapacitySpec
+    from inferno_tpu.planner.replay import replay_scenario
+    from inferno_tpu.planner.scenarios import base_rates_from_system, diurnal
+    from inferno_tpu.testing.fleet import fleet_capacity
+
+    spec = fleet_system_spec(
+        18, shapes_per_variant=2, priority_classes=3, split_pools=True
+    )
+    base_usage = fleet_capacity(spec, 1.0, backend="jax")
+    reset_fleet_state()
+    # budgets at 60% of base consumption; diurnal peaks reach 1.6x base,
+    # so every pool binds mid-cycle; plus a tighter r0 carve-out
+    spec.capacity = CapacitySpec(
+        chips={p: max(int(c * 0.6), 1) for p, c in base_usage.items()},
+        quotas={"gen0/r0": max(int(base_usage["gen0"] * 0.3), 1)},
+    )
+    system = System(spec)
+    trace = diurnal(base_rates_from_system(system), 24, 3600.0, seed=2)
+    report = replay_scenario(system, trace, backend="jax", include_series=True)
+    block = report["reactive"]
+    assert set(block["pools"]) == set(base_usage)
+    gen0 = block["pools"]["gen0"]
+    assert gen0["peak"] >= gen0["p95"] >= gen0["mean"] > 0
+    assert gen0["first_bind_step"] is not None
+    assert len(gen0["series"]) == 24
+    quota = block["quotas"]["gen0/r0"]
+    assert quota["budget_chips"] > 0 and quota["first_bind_step"] is not None
+    assert block["binding_steps"] > 0
+    assert report["steps"] == 24
+    zeroed = block["zeroed_upper_bound"]
+    assert zeroed["variant_steps"] > 0 and zeroed["peak_concurrent"] > 0
+    assert block["violation_seconds"] == zeroed["variant_steps"] * 3600.0
+    # degradation honors priority: the lowest class bleeds at least as
+    # many variant-steps as the highest
+    by_prio = {int(k): v for k, v in zeroed["by_priority"].items()}
+    assert by_prio and max(by_prio) > min(by_prio, default=0)
+    assert by_prio[max(by_prio)] >= by_prio.get(1, 0)
+    cost = block["cost"]
+    assert cost["peak_usd_per_hr"] >= cost["p95_usd_per_hr"] > 0
+    assert cost["total_usd"] > 0 and len(cost["series_usd_per_hr"]) == 24
+
+
+def test_binding_pools_without_quotas():
+    """Pool budgets binding with NO quota buckets configured: the
+    degradation estimate must still run (regression: empty quota_bind
+    indexing) and zero someone."""
+    from inferno_tpu.config.types import CapacitySpec
+    from inferno_tpu.planner.replay import replay_scenario
+    from inferno_tpu.planner.scenarios import base_rates_from_system, diurnal
+    from inferno_tpu.testing.fleet import fleet_capacity
+
+    spec = fleet_system_spec(
+        12, shapes_per_variant=2, priority_classes=2, split_pools=True
+    )
+    usage = fleet_capacity(spec, 1.0, backend="jax")
+    reset_fleet_state()
+    spec.capacity = CapacitySpec(
+        chips={p: max(int(c * 0.6), 1) for p, c in usage.items()}
+    )
+    system = System(spec)
+    trace = diurnal(base_rates_from_system(system), 12, 3600.0, seed=4)
+    block = replay_scenario(system, trace, backend="jax")["reactive"]
+    assert block["quotas"] == {}
+    assert block["binding_steps"] > 0
+    assert block["zeroed_upper_bound"]["variant_steps"] > 0
+
+
+def test_unconfigured_pools_report_demand_only():
+    from inferno_tpu.planner.replay import replay_scenario
+    from inferno_tpu.planner.scenarios import base_rates_from_system, diurnal
+
+    system = System(fleet_system_spec(10, shapes_per_variant=1))
+    trace = diurnal(base_rates_from_system(system), 6, 3600.0, seed=0)
+    block = replay_scenario(system, trace, backend="jax")["reactive"]
+    pool = block["pools"]["v5e"]
+    assert pool["peak"] > 0
+    assert "budget_chips" not in pool and "first_bind_step" not in pool
+    assert block["binding_steps"] == 0 and block["violation_seconds"] == 0.0
+
+
+def test_forecast_bound_rates_dominate_observed():
+    from inferno_tpu.planner.replay import forecast_bound_rates
+
+    rng = np.random.default_rng(0)
+    rates = 100.0 + np.cumsum(rng.uniform(-2.0, 6.0, size=(40, 3)), axis=0)
+    eff = forecast_bound_rates(rates, 60.0, 120.0)
+    assert eff.shape == rates.shape
+    assert (eff >= rates - 1e-9).all()
+    assert (eff > rates).any()  # the band actually binds somewhere
+
+
+def test_planner_cli_smoke(tmp_path):
+    from inferno_tpu.planner.__main__ import main
+
+    out = tmp_path / "plan.json"
+    rc = main([
+        "--variants", "12", "--steps", "6", "--shapes", "1",
+        "--scenarios", "diurnal,ramp", "--backend", "jax",
+        "--quotas", '{"gen0": 64}', "--out", str(out),
+    ])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["fleet"]["variants"] == 12
+    assert [s["scenario"] for s in report["scenarios"]] == ["diurnal", "ramp"]
+    for s in report["scenarios"]:
+        # the CLI fleet is split-pool (gen0/gen1), so the quota bucket
+        # attaches to gen0's shapes
+        assert "gen0" in s["reactive"]["quotas"]
+        assert s["reactive"]["cost"]["total_usd"] >= 0
+
+
+def test_replay_budget_500_variants():
+    """Fast budget guard (ISSUE-8): a 500-variant, 168-step replay —
+    snapshot derivation once, one rate-independent solve, vectorized
+    per-timestep fold/argmin — must fit a generous CPU budget after jit
+    warmup. Catches a return to per-timestep solve work, not box noise
+    (min-of-3, wide ceiling)."""
+    import time
+
+    from inferno_tpu.planner.scenarios import base_rates_from_system, diurnal
+
+    BUDGET_MS = 3000.0
+    system = System(fleet_system_spec(500, shapes_per_variant=1))
+    trace = diurnal(base_rates_from_system(system), 168, 3600.0, seed=0)
+    calculate_fleet_batch(system, trace.rates[:1], backend="jax")  # warmup
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        calculate_fleet_batch(system, trace.rates, backend="jax")
+        times.append((time.perf_counter() - t0) * 1000.0)
+    assert min(times) <= BUDGET_MS, (
+        f"500-variant 168-step replay took {min(times):.0f}ms "
+        f"(budget {BUDGET_MS:.0f}ms); the batched time-axis path regressed"
+    )
+
+
+def test_compact_line_carries_planner_keys():
+    """Bench wiring: planner_week_ms and planner_speedup ride the
+    compact line when the planner block is present."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench
+
+    ns_stub = {
+        "chosen_shape": "v5e-4-int8",
+        "per_shape_provenance": {"v5e-4-int8": "measured"},
+        "a100": {"usd_per_mtok": 0.2},
+        "tpu": {"usd_per_mtok": 0.125},
+        "vs_baseline": 1.27,
+    }
+    planner = {"planner_week_ms": 609.0, "planner_speedup": 214.5}
+    line = bench.compact_line(
+        ns_stub, {"platform": "cpu", "auto_selected_ms": 1.0},
+        {"probed": True, "reachable": False}, planner=planner,
+    )
+    doc = json.loads(line)
+    assert doc["extra"]["planner_week_ms"] == 609.0
+    assert doc["extra"]["planner_speedup"] == 214.5
+
+
+def test_planner_suite_stays_in_fast_tier():
+    """No test in this module may carry the `slow` marker — the parity
+    and budget assertions above must stay inside tier-1's
+    `-m 'not slow'` run."""
+    import pathlib
+
+    marker = "mark." + "slow"  # split so this line doesn't self-match
+    text = (pathlib.Path(__file__).parent / "test_planner.py").read_text()
+    assert marker not in text
