@@ -6,7 +6,8 @@ IMG ?= inferno-tpu-autoscaler:latest
 CLUSTER ?= inferno-tpu
 
 .PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
-        bench-sizing bench-capacity bench-planner native lint lint-metrics \
+        bench-sizing bench-capacity bench-planner bench-recorder native \
+        lint lint-metrics \
         manifests-sync docker-build deploy-kind deploy undeploy clean
 
 all: native test
@@ -60,6 +61,14 @@ bench-planner:
 # (docs/performance.md). One JSON line on stdout.
 bench-cycle:
 	$(PYTHON) bench.py --cycle
+
+# Flight-recorder benchmark (ISSUE-10): record a 200-variant 30-cycle
+# MiniProm-backed reconcile run, replay the artifact through the
+# planner's batched solve, ASSERT capture overhead <= 3% of the PR 5
+# cycle time and choice/replica parity at sampled cycles; recorded in
+# bench_full.json
+bench-recorder:
+	$(PYTHON) bench.py --recorder
 
 # Build the native C++ solver in place (also built on demand at import).
 native:

@@ -581,6 +581,183 @@ def reconcile_cycle_bench(n_variants: int = 200, repeats: int = 3) -> dict:
 BENCH_R05_CYCLE_MS = 333.0  # optimized 200-variant reconcile cycle, BENCH_r05
 
 
+def flight_recorder_bench(
+    n_variants: int = 200, cycles: int = 30, overhead_budget_pct: float = 3.0
+) -> dict:
+    """Flight-recorder overhead + record->replay parity (ISSUE-10,
+    `make bench-recorder`): drive a MiniProm-HTTP-backed N-variant fleet
+    for `cycles` whole reconcile cycles twice — recorder off, then on —
+    and ASSERT (1) the recorder's hot-path overhead stays within
+    `overhead_budget_pct` of the PR 5 reference cycle time
+    (BENCH_R05_CYCLE_MS: the capture path is a bounded-queue enqueue;
+    serialization and disk I/O live on the writer thread), and (2) the
+    recorded artifact replays through the planner's batched solve with
+    choice/replica parity at sampled cycles (first/middle/last, each
+    against its own recorded fleet snapshot). Raises on either failure —
+    a recorder that slows the cycle or records something unreplayable
+    did not pass."""
+    import shutil
+    import tempfile
+
+    from inferno_tpu.controller.promclient import HttpPromClient, PromConfig
+    from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+    from inferno_tpu.emulator.miniprom import MiniProm
+    from inferno_tpu.obs.recorder import read_artifact
+    from inferno_tpu.planner.replay import (
+        replay_cycle_parity,
+        replay_recorded,
+        system_from_recorded,
+    )
+    from inferno_tpu.testing.fleet import (
+        CONFIG_NS,
+        FLEET_NS,
+        fleet_cluster,
+        fleet_targets,
+    )
+
+    prom_srv = MiniProm(
+        [(t, {"namespace": FLEET_NS}) for t in fleet_targets(n_variants)],
+        scrape_interval=3600.0,
+        window_seconds=3600.0,
+    )
+    prom_srv.scrape_once()
+    time.sleep(0.2)
+    prom_srv.scrape_once()
+    prom_srv.start()
+    import logging as _logging
+
+    rec_log = _logging.getLogger("inferno.reconciler")
+    prev_level = rec_log.level
+    rec_log.setLevel(_logging.WARNING)
+    trace_dir = tempfile.mkdtemp(prefix="inferno-recorder-bench-")
+    try:
+        def build(recorder_dir: str) -> "Reconciler":
+            # the "jax" backend keeps the live solve on the SAME batched
+            # pipeline the replay uses, so parity is the pinned
+            # T=1-bit-identical contract (tests/test_planner.py), not a
+            # cross-backend comparison
+            rec = Reconciler(
+                kube=fleet_cluster(n_variants),
+                prom=HttpPromClient(
+                    PromConfig(base_url=prom_srv.url, allow_http=True)
+                ),
+                config=ReconcilerConfig(
+                    config_namespace=CONFIG_NS, compute_backend="jax",
+                    grouped_collection=True, reconcile_concurrency=16,
+                    flight_recorder_dir=recorder_dir,
+                ),
+            )
+            rec_log.setLevel(_logging.WARNING)
+            return rec
+
+        # Interleaved A/B: a ~200 ms cycle wanders tens of ms with heap
+        # growth and CPU state, so two SEQUENTIAL 30-cycle runs measure
+        # drift, not the recorder (observed: a 28 ms phantom "overhead"
+        # on identical code). Alternating off/on cycles samples both
+        # configs under the same conditions. Between cycles the writer
+        # queue is drained OUTSIDE the timed window — mirroring
+        # production, where serialization and disk I/O happen during the
+        # 60 s interval idle; what the timed window charges is the
+        # recorder's actual hot-path cost (the bounded-queue enqueue),
+        # which is the contract bench-recorder pins.
+        rec_off = build("")
+        rec_on = build(trace_dir)
+        rec_off.run_cycle()  # warmup: jit compile + connection setup
+        rec_on.run_cycle()
+        rec_on.recorder.flush()
+        times_off, times_on = [], []
+        for _ in range(cycles):
+            t0 = time.perf_counter()
+            rec_off.run_cycle()
+            times_off.append((time.perf_counter() - t0) * 1000.0)
+            t0 = time.perf_counter()
+            rec_on.run_cycle()
+            times_on.append((time.perf_counter() - t0) * 1000.0)
+            rec_on.recorder.flush()
+        dropped = rec_on.recorder.dropped
+        rec_off.close()
+        rec_on.close()  # joins pool AND flushes/stops the recorder
+        median_off = sorted(times_off)[len(times_off) // 2]
+        median_on = sorted(times_on)[len(times_on) // 2]
+        overhead_ms = median_on - median_off
+        overhead_pct = overhead_ms / BENCH_R05_CYCLE_MS * 100.0
+        if overhead_ms > overhead_budget_pct / 100.0 * BENCH_R05_CYCLE_MS:
+            raise RuntimeError(
+                f"flight recorder overhead {overhead_ms:.1f} ms exceeds "
+                f"{overhead_budget_pct}% of the PR 5 cycle time "
+                f"({BENCH_R05_CYCLE_MS} ms)"
+            )
+        if dropped:
+            raise RuntimeError(
+                f"flight recorder dropped {dropped} cycles during the bench "
+                "(writer thread could not keep up)"
+            )
+
+        recorded = read_artifact(trace_dir)
+        # the warmup cycle records too: cycles + 1 total
+        if recorded.num_cycles != cycles + 1:
+            raise RuntimeError(
+                f"expected {cycles + 1} recorded cycles, read "
+                f"{recorded.num_cycles} (warnings: {recorded.warnings})"
+            )
+        artifact_bytes = sum(
+            f.stat().st_size for f in Path(trace_dir).iterdir()
+        )
+        t0 = time.perf_counter()
+        system = system_from_recorded(recorded)
+        replay = replay_recorded(system, recorded, backend="jax")
+        replay_ms = (time.perf_counter() - t0) * 1000.0
+        # the bench just recorded this artifact, so every sampled
+        # cycle's snapshot must resolve — a miss is a recorder bug and
+        # replay_cycle_parity's KeyError should surface it
+        parity = [
+            replay_cycle_parity(recorded, k, backend="jax")
+            for k in recorded.sampled_cycles()
+        ]
+        for p in parity:
+            if not p["match"]:
+                raise RuntimeError(
+                    f"record->replay parity FAILED at cycle {p['cycle_index']}: "
+                    f"{p['mismatches'][:3]}"
+                )
+        return {
+            "n_variants": n_variants,
+            "cycles": cycles,
+            "cycle_ms_off": round(median_off, 1),
+            "cycle_ms_on": round(median_on, 1),
+            "recorder_overhead_ms": round(overhead_ms, 2),
+            "recorder_overhead_pct": round(overhead_pct, 2),
+            "overhead_budget_pct": overhead_budget_pct,
+            "overhead_reference_ms": BENCH_R05_CYCLE_MS,
+            "dropped": dropped,
+            "artifact_bytes": artifact_bytes,
+            "snapshots": len(recorded.snapshots),
+            "recorder_replay_ms": round(replay_ms, 1),
+            "replay_cost_mean_usd_per_hr": replay["reactive"]["cost"][
+                "mean_usd_per_hr"
+            ],
+            "parity": [
+                {"cycle": p["cycle_index"], "compared": p["compared"],
+                 "skipped": p["skipped"], "match": p["match"]}
+                for p in parity
+            ],
+            "provenance": (
+                "miniprom-http-sockets/in-memory-cluster/jax-backend: live "
+                "cycles and replay share the batched sizing pipeline, so "
+                "parity is the pinned T=1 contract; overhead is the "
+                "recorder's hot-path (bounded-queue enqueue) cost from "
+                "interleaved on/off cycles with the writer drained in the "
+                "inter-cycle gap (as in production, where it works during "
+                "the interval idle), measured against BENCH_r05's "
+                "200-variant cycle reference"
+            ),
+        }
+    finally:
+        rec_log.setLevel(prev_level)
+        prom_srv.stop()
+        shutil.rmtree(trace_dir, ignore_errors=True)
+
+
 def sizing_scaling_bench(
     sizes: tuple[int, ...] = (200, 1000, 3000, 10000),
     repeats: int = 4,
@@ -1554,7 +1731,8 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
                        reconcile_cycle: dict | None = None,
                        sizing: dict | None = None,
                        capacity: dict | None = None,
-                       planner: dict | None = None) -> dict:
+                       planner: dict | None = None,
+                       recorder: dict | None = None) -> dict:
     """Everything the bench measures, in one document — written to
     `bench_full.json`, NOT printed (the printed line is `compact_line`)."""
     return {
@@ -1619,12 +1797,18 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
         # batched time-axis replay vs the serial per-timestep loop
         # (ISSUE-8): a 10k-variant diurnal week in one pass
         **({"planner": planner} if planner else {}),
+        # flight-recorder capture overhead + record->replay parity
+        # (ISSUE-10): a 200-variant 30-cycle MiniProm run recorded and
+        # replayed through the planner
+        **({"recorder": recorder} if recorder else {}),
     }
 
 
 # optional `extra` fields in drop order on a 1024-byte overflow: least
 # headline-critical first (the full payload always carries everything)
 _COMPACT_DROP_ORDER = (
+    "recorder_overhead_pct",
+    "recorder_replay_ms",
     "planner_week_ms",
     "planner_speedup",
     "capacity_10k_ms",
@@ -1652,7 +1836,8 @@ def compact_line(ns: dict, cycles: dict, tpu_probe: dict,
                  reconcile_cycle: dict | None = None,
                  sizing: dict | None = None,
                  capacity: dict | None = None,
-                 planner: dict | None = None) -> str:
+                 planner: dict | None = None,
+                 recorder: dict | None = None) -> str:
     """The ONE printed JSON line. Round-4 postmortem: the driver captures
     only a tail window of stdout, and round 4's ~4 KB single line was cut
     mid-object (`BENCH_r04.json parsed: null`) — a benchmark whose number
@@ -1685,6 +1870,9 @@ def compact_line(ns: dict, cycles: dict, tpu_probe: dict,
         **({"planner_week_ms": planner["planner_week_ms"],
             "planner_speedup": planner["planner_speedup"]}
            if planner and "planner_week_ms" in planner else {}),
+        **({"recorder_overhead_pct": recorder["recorder_overhead_pct"],
+            "recorder_replay_ms": recorder["recorder_replay_ms"]}
+           if recorder and "recorder_overhead_pct" in recorder else {}),
         **({"p99_ttft_measured_ms": measured_p99["p99_ttft_ms"],
             "p99_meets_slo": measured_p99["meets_slo"]}
            if measured_p99 else {}),
@@ -1752,6 +1940,12 @@ def main() -> None:
                          "(make bench-planner: a 10k-variant diurnal week "
                          "vs the serial per-timestep loop), print its JSON, "
                          "and merge it into bench_full.json")
+    ap.add_argument("--recorder", action="store_true",
+                    help="run ONLY the flight-recorder benchmark (make "
+                         "bench-recorder: a 200-variant 30-cycle MiniProm "
+                         "run recorded and replayed; overhead + parity "
+                         "asserted), print its JSON, and merge it into "
+                         "bench_full.json")
     args = ap.parse_args()
     if args.cycle:
         print(json.dumps(reconcile_cycle_bench(args.cycle_variants)))
@@ -1783,6 +1977,12 @@ def main() -> None:
         planner = planner_replay_bench()
         merge_full("planner", planner)
         print(json.dumps(planner))
+        return
+    if args.recorder:
+        _pin_cpu_if_tpu_unreachable()
+        recorder = flight_recorder_bench()
+        merge_full("recorder", recorder)
+        print(json.dumps(recorder))
         return
     from inferno_tpu.obs import Tracer
 
@@ -1864,6 +2064,17 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — artifact must survive
             reconcile_cycle = {"error": f"{type(e).__name__}: {e}"}
             sp.set(error=str(e))
+    # flight-recorder capture/replay (ISSUE-10): guarded; --quick shrinks
+    # the fleet and the cycle count
+    with tracer.span("flight-recorder-bench") as sp:
+        try:
+            recorder = flight_recorder_bench(
+                n_variants=50 if args.quick else 200,
+                cycles=10 if args.quick else 30,
+            )
+        except Exception as e:  # noqa: BLE001 — artifact must survive
+            recorder = {"error": f"{type(e).__name__}: {e}"}
+            sp.set(error=str(e))
     Path(FULL_PAYLOAD_PATH).write_text(
         json.dumps(build_full_payload(ns, cycles, tpu_probe, measured,
                                       calibrated,
@@ -1872,11 +2083,12 @@ def main() -> None:
                                       reconcile_cycle=reconcile_cycle,
                                       sizing=sizing,
                                       capacity=capacity,
-                                      planner=planner),
+                                      planner=planner,
+                                      recorder=recorder),
                    indent=1) + "\n"
     )
     print(compact_line(ns, cycles, tpu_probe, measured, calibrated,
-                       reconcile_cycle, sizing, capacity, planner))
+                       reconcile_cycle, sizing, capacity, planner, recorder))
 
 
 if __name__ == "__main__":

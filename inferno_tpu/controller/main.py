@@ -63,6 +63,17 @@ controller.go:516-582):
                                 implementation (bit-identical results;
                                 docs/performance.md)
   PROMETHEUS_QUERY_TIMEOUT      per-query timeout in seconds (default 30)
+  FLIGHT_RECORDER_DIR           directory for the per-cycle flight-recorder
+                                artifact (default unset = recording off;
+                                docs/observability.md). Replay with
+                                `python -m inferno_tpu.planner --trace`.
+  FLIGHT_RECORDER_MAX_MB        artifact retention budget in MB (default 64;
+                                oldest rotation segments deleted beyond it)
+  FLIGHT_RECORDER_MAX_AGE_S     segment age before rotation (default 3600)
+  ATTAINMENT_EWMA_GAIN          EWMA gain of the SLO-attainment/model-error
+                                scoreboard in (0,1] (default 0.2; see
+                                /debug/attainment and the
+                                inferno_model_error_* gauges)
 """
 
 from __future__ import annotations
@@ -139,17 +150,6 @@ def main() -> int:
     # last-K reconcile-cycle traces + decision records, shared between the
     # reconciler (writer) and the metrics listener (/debug/decisions)
     traces = TraceBuffer(capacity=int(os.environ.get("DECISION_TRACE_BUFFER", "32")))
-    server = MetricsServer(
-        registry,
-        port=int(os.environ.get("METRICS_PORT", "8443")),
-        tls=TLSConfig.from_env(),
-        traces=traces,
-    )
-    server.start()
-    # dedicated probe port so liveness/readiness don't ride the metrics
-    # listener (the manager Deployment probes :8081)
-    health = HealthServer(server.ready_flag, port=int(os.environ.get("HEALTH_PORT", "8081")))
-    health.start()
 
     config = ReconcilerConfig(
         config_namespace=os.environ.get("CONFIG_NAMESPACE", "inferno-system"),
@@ -178,10 +178,35 @@ def main() -> int:
         sizing_cache_tolerance=float(
             os.environ.get("SIZING_CACHE_TOLERANCE", "0.02") or 0.02
         ),
+        # flight recorder + attainment scoreboard (docs/observability.md)
+        flight_recorder_dir=os.environ.get("FLIGHT_RECORDER_DIR", "").strip(),
+        flight_recorder_max_mb=float(
+            os.environ.get("FLIGHT_RECORDER_MAX_MB", "64") or 64
+        ),
+        flight_recorder_max_age_s=float(
+            os.environ.get("FLIGHT_RECORDER_MAX_AGE_S", "3600") or 3600
+        ),
+        attainment_ewma_gain=float(
+            os.environ.get("ATTAINMENT_EWMA_GAIN", "0.2") or 0.2
+        ),
     )
     rec = Reconciler(
         kube=kube, prom=prom, config=config, emitter=emitter, trace_buffer=traces
     )
+    # the metrics listener starts after the reconciler exists so
+    # /debug/attainment can serve the reconciler's live scoreboard
+    server = MetricsServer(
+        registry,
+        port=int(os.environ.get("METRICS_PORT", "8443")),
+        tls=TLSConfig.from_env(),
+        traces=traces,
+        attainment=rec.attainment,
+    )
+    server.start()
+    # dedicated probe port so liveness/readiness don't ride the metrics
+    # listener (the manager Deployment probes :8081)
+    health = HealthServer(server.ready_flag, port=int(os.environ.get("HEALTH_PORT", "8081")))
+    health.start()
     # readiness heartbeat: both probe listeners share this dict, so a
     # reconcile loop that stops cycling (> 3x interval) fails /readyz
     rec.ready_flag = server.ready_flag

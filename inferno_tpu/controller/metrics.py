@@ -303,6 +303,11 @@ METRIC_SIZING_CACHE = "inferno_sizing_cache_lookups"
 METRIC_COLLECT_CONCURRENCY = "inferno_collect_concurrency"
 LABEL_RESULT = "result"
 
+# Flight recorder (obs/recorder.py): cycles the bounded capture queue
+# DROPPED because the writer thread (disk) could not keep up — the
+# recorder's explicit never-stall-a-cycle tradeoff made visible.
+METRIC_RECORDER_DROPPED = "inferno_recorder_dropped_total"
+
 # Collect-pool width buckets: powers of two up to the practical ceiling
 # of RECONCILE_CONCURRENCY (a thread per in-flight variant collect).
 CONCURRENCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
@@ -347,6 +352,11 @@ class CycleInstruments:
             "Concurrent collect workers used per reconcile cycle",
             buckets=CONCURRENCY_BUCKETS,
         )
+        self.recorder_dropped = self.registry.counter(
+            METRIC_RECORDER_DROPPED,
+            "Reconcile cycles the flight recorder dropped because its "
+            "bounded capture queue was full (slow disk)",
+        )
 
     def observe_cycle(self, seconds: float) -> None:
         self.cycle.observe({}, seconds)
@@ -372,6 +382,10 @@ class CycleInstruments:
 
     def observe_collect_concurrency(self, workers: int) -> None:
         self.collect_concurrency.observe({}, float(workers))
+
+    def count_recorder_dropped(self, n: int) -> None:
+        if n > 0:
+            self.recorder_dropped.inc({}, float(n))
 
     def prune_variants(self, active: set[tuple[str, str]]) -> None:
         """Drop per-variant analysis series of variants no longer managed
@@ -440,6 +454,85 @@ class ForecastInstruments:
                     series.remove(labels)
 
 
+# SLO-attainment / model-error scoreboard series (obs/attainment.py).
+# All carry the inferno_ prefix AND a unit suffix per obs/lint.py.
+METRIC_MODEL_ERROR_TTFT = "inferno_model_error_ttft_ms"
+METRIC_MODEL_ERROR_ITL = "inferno_model_error_itl_ms"
+METRIC_SLO_ATTAINMENT = "inferno_slo_attainment_ratio"
+METRIC_ERROR_BUDGET_BURN = "inferno_error_budget_burn_ratio"
+LABEL_DIMENSION = "dimension"  # ttft | itl
+
+
+class AttainmentInstruments:
+    """Per-variant scoreboard gauges: EWMA |model error| for TTFT and
+    ITL (how far the queueing model's prediction drifts from observed
+    telemetry), the SLO-attainment ratio per latency dimension, and the
+    error-budget burn rate (unattained fraction over the allowed
+    fraction; > 1 = burning budget faster than the objective allows).
+    Registered unconditionally, like the forecast gauges, so the metric
+    catalog (and `make lint-metrics`) is independent of configuration;
+    labeled (namespace, variant_name) and pruned with the actuation
+    gauges."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self.error_ttft = self.registry.gauge(
+            METRIC_MODEL_ERROR_TTFT,
+            "EWMA absolute model error of predicted vs observed TTFT",
+        )
+        self.error_itl = self.registry.gauge(
+            METRIC_MODEL_ERROR_ITL,
+            "EWMA absolute model error of predicted vs observed ITL",
+        )
+        self.attainment = self.registry.gauge(
+            METRIC_SLO_ATTAINMENT,
+            "EWMA fraction of cycles with observed latency within the SLO, "
+            "per latency dimension",
+        )
+        self.burn = self.registry.gauge(
+            METRIC_ERROR_BUDGET_BURN,
+            "Error-budget burn rate: unattained fraction over the allowed "
+            "fraction (>1 = burning faster than the objective allows)",
+        )
+
+    def _labels(self, namespace: str, variant: str) -> dict[str, str]:
+        return {LABEL_OUT_NAMESPACE: namespace, LABEL_VARIANT: variant}
+
+    def set_score(self, namespace: str, variant: str, score) -> None:
+        """Publish one variant's obs.attainment.AttainmentScore.
+        Dimensions without data (no SLO, never observed) emit nothing —
+        a 0.0 attainment gauge would read as a total outage."""
+        labels = self._labels(namespace, variant)
+        # per-dimension gating: a variant whose engine reports only one
+        # latency dimension must not publish a 0.0 "perfect model" gauge
+        # for the other
+        if score.ttft_error_scored:
+            self.error_ttft.set(labels, score.ttft_error_ewma_ms)
+        if score.itl_error_scored:
+            self.error_itl.set(labels, score.itl_error_ewma_ms)
+        if score.ttft_attainment is not None:
+            self.attainment.set(
+                {**labels, LABEL_DIMENSION: "ttft"}, score.ttft_attainment
+            )
+        if score.itl_attainment is not None:
+            self.attainment.set(
+                {**labels, LABEL_DIMENSION: "itl"}, score.itl_attainment
+            )
+        if score.ttft_attainment is not None or score.itl_attainment is not None:
+            self.burn.set(labels, score.burn_rate)
+
+    def prune_variants(self, active: set[tuple[str, str]]) -> None:
+        """Drop scoreboard series of variants no longer managed (same
+        contract as MetricsEmitter.prune_variants)."""
+        for series in (self.error_ttft, self.error_itl, self.attainment,
+                       self.burn):
+            for _, (labels, _v) in list(series.values.items()):
+                key = (labels.get(LABEL_OUT_NAMESPACE, ""),
+                       labels.get(LABEL_VARIANT, ""))
+                if key not in active:
+                    series.remove(labels)
+
+
 class TLSConfig:
     """Serve-side TLS with cert reload (the reference uses certwatchers on
     its metrics endpoint, cmd/main.go:122-199). Certs are re-read when the
@@ -493,14 +586,27 @@ class TLSConfig:
 
 
 class _RouteServer:
-    """Threaded HTTP(S) listener serving a map of path -> () -> (code,
-    content-type, body)."""
+    """Threaded HTTP(S) listener serving a map of path -> (query: dict)
+    -> (code, content-type, body). The query dict holds the URL's query
+    parameters (last value wins on repeats); routes that take no
+    parameters simply ignore it."""
 
     def __init__(self, routes: dict, port: int, host: str = "", tls: TLSConfig | None = None):
+        from urllib.parse import parse_qs, urlsplit
+
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                route = routes.get(self.path)
-                code, ctype, body = route() if route else (404, None, b"not found")
+                parsed = urlsplit(self.path)
+                route = routes.get(parsed.path)
+                query = {
+                    k: v[-1]
+                    for k, v in parse_qs(
+                        parsed.query, keep_blank_values=True
+                    ).items()
+                }
+                code, ctype, body = (
+                    route(query) if route else (404, None, b"not found")
+                )
                 self.send_response(code)
                 if ctype:
                     self.send_header("Content-Type", ctype)
@@ -550,7 +656,7 @@ class _RouteServer:
 
 
 def _probe_routes(ready_flag: dict) -> dict:
-    def readyz():
+    def readyz(query=None):
         if not ready_flag["ready"]:
             return (503, None, b"not ready")
         # Stale-controller detection: the reconciler heartbeats
@@ -575,7 +681,7 @@ def _probe_routes(ready_flag: dict) -> dict:
                         f"(budget {max_age:.0f}s)".encode())
         return (200, None, b"ok")
 
-    return {"/healthz": lambda: (200, None, b"ok"), "/readyz": readyz}
+    return {"/healthz": lambda query=None: (200, None, b"ok"), "/readyz": readyz}
 
 
 class HealthServer(_RouteServer):
@@ -588,12 +694,72 @@ class HealthServer(_RouteServer):
         super().__init__(_probe_routes(ready_flag), port, host)
 
 
+def _decisions_route(traces):
+    """The /debug/decisions handler: the last-K cycle traces, optionally
+    narrowed by query filters so a large-fleet ring is inspectable
+    without downloading everything:
+
+      ?cycles=<N>      only the newest N cycles
+      ?variant=<id>    per cycle, only that variant's DecisionRecords
+                       (matched on the record's full `variant` id); the
+                       span tree is omitted — it is fleet-wide and would
+                       dwarf the filtered payload
+
+    Unknown or malformed parameters are a 400, never a silent
+    full-ring download."""
+
+    def _bad(msg: str):
+        return (400, "application/json", json.dumps({"error": msg}).encode())
+
+    def decisions(query=None):
+        query = query or {}
+        unknown = sorted(set(query) - {"variant", "cycles"})
+        if unknown:
+            return _bad(
+                f"unknown parameter(s) {unknown}; supported: variant, cycles"
+            )
+        variant = query.get("variant", "")
+        if "variant" in query and not variant:
+            return _bad("variant must be a non-empty variant id")
+        n_cycles = None
+        if "cycles" in query:
+            try:
+                n_cycles = int(query["cycles"])
+            except ValueError:
+                return _bad(f"cycles must be an integer, got {query['cycles']!r}")
+            if n_cycles < 1:
+                return _bad(f"cycles must be >= 1, got {n_cycles}")
+        cycles = traces.snapshot()
+        if n_cycles is not None:
+            cycles = cycles[-n_cycles:]
+        if variant:
+            cycles = [
+                {
+                    **{k: v for k, v in cyc.items() if k != "spans"},
+                    "decisions": [
+                        d for d in cyc.get("decisions", [])
+                        if d.get("variant") == variant
+                    ],
+                }
+                for cyc in cycles
+            ]
+        body = json.dumps(
+            {"capacity": traces.capacity, "cycles": cycles}, default=str
+        )
+        return (200, "application/json", body.encode())
+
+    return decisions
+
+
 class MetricsServer(_RouteServer):
     """Serves /metrics (plus the probe routes, for single-port setups) on
     a background thread. Given a TraceBuffer, also serves
     /debug/decisions: the last-K reconcile-cycle traces, each carrying
     its per-variant DecisionRecords — the operator's "why did replicas
-    jump?" endpoint (docs/observability.md)."""
+    jump?" endpoint, with `?variant=`/`?cycles=` filters for large
+    fleets. Given an obs.attainment.AttainmentTracker, also serves
+    /debug/attainment: the per-variant SLO-attainment / model-error
+    scoreboard (docs/observability.md)."""
 
     def __init__(
         self,
@@ -602,23 +768,24 @@ class MetricsServer(_RouteServer):
         host: str = "",
         tls: TLSConfig | None = None,
         traces=None,  # obs.TraceBuffer
+        attainment=None,  # obs.attainment.AttainmentTracker
     ):
         self.registry = registry
         self.traces = traces
+        self.attainment = attainment
         self.ready_flag = {"ready": True}
 
-        def metrics():
+        def metrics(query=None):
             return (200, "text/plain; version=0.0.4", registry.render().encode())
 
         routes = {"/metrics": metrics, **_probe_routes(self.ready_flag)}
         if traces is not None:
+            routes["/debug/decisions"] = _decisions_route(traces)
+        if attainment is not None:
 
-            def decisions():
-                body = json.dumps(
-                    {"capacity": traces.capacity, "cycles": traces.snapshot()},
-                    default=str,
-                )
+            def attainment_route(query=None):
+                body = json.dumps(attainment.snapshot(), default=str)
                 return (200, "application/json", body.encode())
 
-            routes["/debug/decisions"] = decisions
+            routes["/debug/attainment"] = attainment_route
         super().__init__(routes, port, host, tls=tls)

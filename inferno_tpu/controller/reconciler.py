@@ -188,6 +188,21 @@ class ReconcilerConfig:
                 f"sizing_cache_tolerance must be >= 0, "
                 f"got {self.sizing_cache_tolerance}"
             )
+        if not (0.0 < self.attainment_ewma_gain <= 1.0):
+            raise ValueError(
+                f"attainment_ewma_gain must be in (0, 1], "
+                f"got {self.attainment_ewma_gain}"
+            )
+        if self.flight_recorder_max_mb <= 0:
+            raise ValueError(
+                f"flight_recorder_max_mb must be > 0, "
+                f"got {self.flight_recorder_max_mb}"
+            )
+        if self.flight_recorder_max_age_s <= 0:
+            raise ValueError(
+                f"flight_recorder_max_age_s must be > 0, "
+                f"got {self.flight_recorder_max_age_s}"
+            )
         engine_for(self.engine)  # raise at config time on unknown engines
         if not self.keep_accelerator and self.direct_scale:
             # direct_scale only patches replica counts on the EXISTING
@@ -249,6 +264,18 @@ class ReconcilerConfig:
     # candidate allocations instead of re-solving
     sizing_cache: bool = False
     sizing_cache_tolerance: float = 0.02
+    # -- flight recorder + attainment scoreboard (ISSUE-10, obs/) ------------
+    # durable per-cycle trace capture (env FLIGHT_RECORDER_DIR, default
+    # off): every cycle's fleet snapshot + per-variant inputs/decisions
+    # land in an append-only, rotated artifact written off the hot path
+    # (obs/recorder.py); replayable via `python -m inferno_tpu.planner
+    # --trace` and scored by `python -m inferno_tpu.obs.report`
+    flight_recorder_dir: str = ""
+    flight_recorder_max_mb: float = 64.0  # env FLIGHT_RECORDER_MAX_MB
+    flight_recorder_max_age_s: float = 3600.0  # env FLIGHT_RECORDER_MAX_AGE_S
+    # EWMA gain for the model-error / SLO-attainment scoreboard
+    # (obs/attainment.py; env ATTAINMENT_EWMA_GAIN)
+    attainment_ewma_gain: float = 0.2
 
 
 @dataclasses.dataclass
@@ -335,6 +362,7 @@ class Reconciler:
         trace_buffer: TraceBuffer | None = None,
     ):
         from inferno_tpu.controller.metrics import (
+            AttainmentInstruments,
             CycleInstruments,
             ForecastInstruments,
             MetricsEmitter,
@@ -410,6 +438,37 @@ class Reconciler:
             self.sizing_cache = SizingCache(self.config.sizing_cache_tolerance)
         else:
             self.sizing_cache = None
+        # SLO-attainment / model-error scoreboard (obs/attainment.py):
+        # always on — it only consumes telemetry the cycle already
+        # collected. Gauges register unconditionally (lint parity).
+        from inferno_tpu.obs.attainment import AttainmentConfig, AttainmentTracker
+
+        self.attainment = AttainmentTracker(
+            AttainmentConfig(ewma_gain=self.config.attainment_ewma_gain)
+        )
+        self.attainment_instruments = AttainmentInstruments(self.emitter.registry)
+        # flight recorder (obs/recorder.py, env FLIGHT_RECORDER_DIR,
+        # default off): per-cycle fleet snapshot + decisions, enqueued in
+        # _finish_cycle and written off the hot path
+        if self.config.flight_recorder_dir:
+            from inferno_tpu.obs.recorder import FlightRecorder, RecorderConfig
+
+            self.recorder = FlightRecorder(RecorderConfig(
+                dir=self.config.flight_recorder_dir,
+                max_mb=self.config.flight_recorder_max_mb,
+                max_age_s=self.config.flight_recorder_max_age_s,
+            ))
+            self.log.info(
+                "flight recorder on: %s (max %.0f MB)",
+                self.config.flight_recorder_dir,
+                self.config.flight_recorder_max_mb,
+            )
+        else:
+            self.recorder = None
+        self._recorder_dropped_seen = 0
+        # the SystemSpec the in-flight cycle's solve consumed, stashed
+        # for the recorder (reconcile thread only; cleared per cycle)
+        self._cycle_spec = None
         # persistent worker pool shared by the collect and apply stages
         # (reconcile_concurrency > 1 only; lazily created, kept across
         # cycles). Tearing a pool down every cycle would kill the worker
@@ -442,11 +501,14 @@ class Reconciler:
         return self._pool
 
     def close(self) -> None:
-        """Release the persistent worker pool (main() on shutdown; safe to
-        call on a never-pooled or already-closed reconciler)."""
+        """Release the persistent worker pool and flush the flight
+        recorder (main() on shutdown; safe to call on a never-pooled or
+        already-closed reconciler)."""
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
+        if self.recorder is not None:
+            self.recorder.close()
 
     # -- config reading -----------------------------------------------------
 
@@ -797,6 +859,8 @@ class Reconciler:
         rec.arrival_rpm = current.load.arrival_rate
         rec.ttft_observed_ms = current.ttft_average
         rec.itl_observed_ms = current.itl_average
+        rec.avg_in_tokens = current.load.avg_input_tokens
+        rec.avg_out_tokens = current.load.avg_output_tokens
         rec.prev_accelerator = current.accelerator
         rec.prev_replicas = current.num_replicas
         rec.prev_cost = current.variant_cost
@@ -929,6 +993,19 @@ class Reconciler:
                     perf.decode_parms, perf.prefill_parms = corr_decode, corr_prefill
             spec.models.append(perf)
 
+        # the parameters sizing actually runs with for the CURRENT slice
+        # shape (post-corrector), onto the record — the flight recorder's
+        # "corrected profile parms" column and the scoreboard's
+        # prediction provenance
+        acc_cur = current.accelerator or matching_profiles[0].acc
+        for perf in spec.models[-len(matching_profiles):]:
+            if perf.acc == acc_cur:
+                rec.decode_alpha = perf.decode_parms.alpha
+                rec.decode_beta = perf.decode_parms.beta
+                rec.prefill_gamma = perf.prefill_parms.gamma
+                rec.prefill_delta = perf.prefill_parms.delta
+                break
+
         if corr_state is not None and corr_state.active:
             # the running shape has direct telemetry; the other candidate
             # shapes carry the multiplicative residual (assumed systematic)
@@ -1045,6 +1122,8 @@ class Reconciler:
             self.emitter.prune_variants(active)
             self.instruments.prune_variants(active)
             self.forecast_instruments.prune_variants(active)
+            self.attainment_instruments.prune_variants(active)
+            self.attainment.prune({va.full_name for va in vas})
             if self.corrector is not None:
                 self.corrector.prune({va.full_name for va in vas})
             # forecaster/stabilizer state is keyed by variant full name:
@@ -1156,6 +1235,11 @@ class Reconciler:
             return
 
         system = System(spec)
+        if self.recorder is not None:
+            # stash for _finish_cycle: the exact spec this cycle's solve
+            # consumes (per-cycle-fresh objects — safe to serialize on
+            # the recorder's writer thread after the cycle completes)
+            self._cycle_spec = spec
         with tracer.span("solve", backend=self.config.compute_backend) as sp:
             t0 = time.perf_counter()
             try:
@@ -1280,14 +1364,41 @@ class Reconciler:
                 rec.sizing_provenance = SIZING_PROVENANCE_CACHED
 
     def _finish_cycle(self, tracer: Tracer, report: CycleReport) -> None:
-        """Seal the cycle's observability outputs: trace, histogram,
-        decision log events, ring-buffer entry, readiness heartbeat."""
+        """Seal the cycle's observability outputs: attainment scoring,
+        trace, histogram, decision log events, ring-buffer entry, flight
+        recorder capture, readiness heartbeat."""
         root = tracer.finish()
         report.trace = root
         self.instruments.observe_cycle(root.duration_ms / 1000.0)
+        # model-error / SLO-attainment scoreboard: score last cycle's
+        # prediction against this cycle's observation and store this
+        # cycle's prediction — BEFORE the records are logged/retained,
+        # so the error fields ride every downstream copy
+        for rec in report.decisions:
+            # a stabilization hold actuates the held PEAK count, not the
+            # size the prediction was computed for — storing that
+            # prediction would score next cycle's held-size telemetry
+            # against a different operating point and report spurious
+            # model drift through every scale-down window (the same
+            # reason replay parity skips holds)
+            held = rec.reason == REASON_STABILIZATION_HOLD
+            score = self.attainment.observe(
+                rec.variant,
+                predicted_ttft_ms=0.0 if held else rec.ttft_predicted_ms,
+                predicted_itl_ms=0.0 if held else rec.itl_predicted_ms,
+                observed_ttft_ms=rec.ttft_observed_ms,
+                observed_itl_ms=rec.itl_observed_ms,
+                slo_ttft_ms=rec.slo_ttft_ms,
+                slo_itl_ms=rec.slo_itl_ms,
+            )
+            rec.ttft_model_error_ms = score.ttft_error_ms or 0.0
+            rec.itl_model_error_ms = score.itl_error_ms or 0.0
+            rec.ttft_model_error_ewma_ms = score.ttft_error_ewma_ms
+            rec.itl_model_error_ewma_ms = score.itl_error_ewma_ms
+            self.attainment_instruments.set_score(rec.namespace, rec.name, score)
         for rec in report.decisions:
             kv(self.log, logging.INFO, "decision", **rec.to_dict())
-        self.traces.append(
+        seq = self.traces.append(
             {
                 "started_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime(tracer.started_at)
@@ -1299,6 +1410,36 @@ class Reconciler:
                 "decisions": [rec.to_dict() for rec in report.decisions],
             }
         )
+        # flight recorder: enqueue the cycle for durable capture (object
+        # refs only — serialization happens on the writer thread). Cycles
+        # that never built a solver input (config error, zero variants)
+        # have nothing replayable and are skipped.
+        if self.recorder is not None:
+            spec, self._cycle_spec = self._cycle_spec, None
+            if spec is not None and report.decisions:
+                self.recorder.record_cycle(
+                    spec,
+                    report.decisions,
+                    {
+                        "seq": seq,
+                        "ts": tracer.started_at,
+                        "started_at": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(tracer.started_at)
+                        ),
+                        "duration_ms": round(root.duration_ms, 3),
+                        "interval_seconds": report.interval_seconds,
+                        "optimization_ok": report.optimization_ok,
+                        "errors": len(report.errors),
+                    },
+                )
+            new_drops = self.recorder.dropped - self._recorder_dropped_seen
+            if new_drops > 0:
+                self._recorder_dropped_seen = self.recorder.dropped
+                self.instruments.count_recorder_dropped(new_drops)
+                self.log.warning(
+                    "flight recorder dropped %d cycle(s): capture queue "
+                    "full (slow disk?)", new_drops,
+                )
         # stale-controller detection (metrics._probe_routes): readiness
         # fails when the newest heartbeat is older than 3x the interval
         self._heartbeat(report.interval_seconds)

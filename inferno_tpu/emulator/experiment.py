@@ -312,10 +312,27 @@ def run_scenario(scenario: Scenario) -> dict[str, Any]:
             result["measured_emu_rps_per_replica"] = emu_rps
             result["model"] = _model_prediction(scenario, emu_rps)
             model = result["model"]
-            if "itl_ms" in model and itl and model["itl_ms"] > 0:
-                result["model_error"] = {
-                    "itl_rel": abs(result["itl_ms"]["mean"] - model["itl_ms"]) / model["itl_ms"]
-                }
+            # model error via the scoreboard's shared guard
+            # (obs/attainment.relative_error — the same convention the
+            # live controller's inferno_model_error_* gauges use), one
+            # entry per latency dimension the model predicted
+            from inferno_tpu.obs import relative_error
+
+            errors = {}
+            if itl:
+                rel = relative_error(
+                    model.get("itl_ms"), result["itl_ms"]["mean"]
+                )
+                if rel is not None:
+                    errors["itl_rel"] = rel
+            if ttft:
+                rel = relative_error(
+                    model.get("ttft_ms"), result["ttft_ms"]["mean"]
+                )
+                if rel is not None:
+                    errors["ttft_rel"] = rel
+            if errors:
+                result["model_error"] = errors
     else:
         result["model"] = {"skipped": "nonstationary rate schedule"}
     result["trace"] = tracer.finish().to_dict()

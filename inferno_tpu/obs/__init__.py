@@ -3,9 +3,17 @@ records, and the metric-catalog lint.
 
 Dependency-free by design (stdlib only, no controller imports) so the
 reconciler, the emulator experiment driver, and bench.py can all thread
-the same tracer without import cycles.
+the same tracer without import cycles. The flight recorder
+(`obs/recorder.py`, numpy-backed) is deliberately NOT re-exported here —
+import it directly so this package root stays stdlib-only.
 """
 
+from inferno_tpu.obs.attainment import (
+    AttainmentConfig,
+    AttainmentScore,
+    AttainmentTracker,
+    relative_error,
+)
 from inferno_tpu.obs.decision import (
     PROVENANCE_CORRECTED,
     PROVENANCE_CR,
@@ -26,6 +34,10 @@ from inferno_tpu.obs.decision import (
 from inferno_tpu.obs.trace import Span, TraceBuffer, Tracer
 
 __all__ = [
+    "AttainmentConfig",
+    "AttainmentScore",
+    "AttainmentTracker",
+    "relative_error",
     "DecisionRecord",
     "PROVENANCE_CORRECTED",
     "PROVENANCE_CR",

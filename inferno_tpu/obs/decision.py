@@ -70,10 +70,24 @@ class DecisionRecord:
     arrival_rpm: float = 0.0  # observed λ, requests/minute
     ttft_observed_ms: float = 0.0
     itl_observed_ms: float = 0.0
+    # observed request token mix (the collector's averages this cycle) —
+    # with arrival_rpm, the full load vector the flight recorder
+    # (obs/recorder.py) needs to make the cycle replayable
+    avg_in_tokens: float = 0.0
+    avg_out_tokens: float = 0.0
     asleep: bool = False  # scaled to zero, sized from gateway demand
 
     # -- sizing inputs ------------------------------------------------------
     profile_provenance: str = PROVENANCE_CR  # "cr" | "corrected"
+    # the linear-profile parameters sizing actually ran with for the
+    # variant's CURRENT slice shape (post-corrector when calibration is
+    # active): ITL = alpha + beta·batch, prefill = gamma + delta·in·batch.
+    # Recorded per cycle so model-error drift is attributable to the
+    # parameter set that produced the prediction.
+    decode_alpha: float = 0.0
+    decode_beta: float = 0.0
+    prefill_gamma: float = 0.0
+    prefill_delta: float = 0.0
     slo_ttft_ms: float = 0.0
     slo_itl_ms: float = 0.0
     # predictive scaling (inferno_tpu/forecast/): the λ the sizing RAN
@@ -112,6 +126,14 @@ class DecisionRecord:
     # SLO minus prediction: positive = margin, negative = expected breach
     ttft_headroom_ms: float = 0.0
     itl_headroom_ms: float = 0.0
+    # model-error scoreboard (obs/attainment.py): this cycle's observed
+    # latency minus the prediction the PREVIOUS cycle made for the size
+    # it decided (signed; 0.0 until a scorable pair exists), and the
+    # EWMA of the absolute error (ATTAINMENT_EWMA_GAIN)
+    ttft_model_error_ms: float = 0.0
+    itl_model_error_ms: float = 0.0
+    ttft_model_error_ewma_ms: float = 0.0
+    itl_model_error_ewma_ms: float = 0.0
     cost: float = 0.0  # cents/hr of the chosen allocation
     prev_cost: float = 0.0
     cost_delta: float = 0.0  # chosen minus previous

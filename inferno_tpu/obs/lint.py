@@ -1,10 +1,11 @@
 """Metric-catalog lint (`make lint-metrics`).
 
-Asserts every series the controller registers carries non-empty help
-text and the `inferno_` name prefix — the two properties
-docs/observability.md relies on to stay a complete catalogue. Runs as a
-CLI (wired into the Makefile) and from tests/test_metrics_lint.py, both
-against the same registry construction the production entry point uses.
+Asserts every series the controller registers carries (1) non-empty help
+text, (2) the `inferno_` name prefix, and (3) a unit suffix from the
+house convention — the three properties docs/observability.md relies on
+to stay a complete, readable catalogue. Runs as a CLI (wired into the
+Makefile) and from tests/test_metrics_lint.py, both against the same
+registry construction the production entry point uses.
 """
 
 from __future__ import annotations
@@ -12,6 +13,22 @@ from __future__ import annotations
 import sys
 
 METRIC_NAME_PREFIX = "inferno_"
+
+# Unit-suffix convention: every series name ends in the unit it is
+# measured in. `_total` marks counters (unitless cumulative counts),
+# `_ratio` dimensionless gauges, the rest physical units.
+UNIT_SUFFIXES = ("_seconds", "_ms", "_total", "_ratio", "_rpm")
+
+# Grandfathered pre-convention names: these shipped before the suffix
+# rule and are part of the external actuation/dashboard contract, so
+# renaming them would break HPA/KEDA queries. New series must NOT be
+# added here without a contract-level reason.
+UNIT_SUFFIX_ALLOWLIST = frozenset({
+    "inferno_desired_replicas",  # HPA/KEDA actuation contract
+    "inferno_current_replicas",  # HPA/KEDA actuation contract
+    "inferno_sizing_cache_lookups",  # ISSUE-5 cycle instrument
+    "inferno_collect_concurrency",  # ISSUE-5 cycle instrument
+})
 
 
 def lint_registry(registry) -> list[str]:
@@ -24,17 +41,28 @@ def lint_registry(registry) -> list[str]:
             )
         if not help_.strip():
             violations.append(f"{name} ({kind}): empty help text")
+        if (
+            not name.endswith(UNIT_SUFFIXES)
+            and name not in UNIT_SUFFIX_ALLOWLIST
+        ):
+            violations.append(
+                f"{name} ({kind}): missing a unit suffix "
+                f"({'|'.join(UNIT_SUFFIXES)}) and not allowlisted"
+            )
     return violations
 
 
 def build_controller_registry():
-    """The full production metric catalog, exactly as main() assembles it:
-    the four actuation series (MetricsEmitter), the cycle-latency
-    histograms (CycleInstruments), and the predictive-scaling forecast
-    gauges (ForecastInstruments — registered unconditionally, like the
-    Reconciler does, so the catalog is identical whether or not
-    PREDICTIVE_SCALING is enabled)."""
+    """The full production metric catalog, exactly as main() assembles
+    it: the four actuation series (MetricsEmitter), the cycle-latency
+    histograms + fleet-cycle instruments + recorder drop counter
+    (CycleInstruments), the predictive-scaling forecast gauges
+    (ForecastInstruments), and the SLO-attainment / model-error
+    scoreboard gauges (AttainmentInstruments) — each registered
+    unconditionally, like the Reconciler does, so the catalog is
+    identical whatever features are enabled."""
     from inferno_tpu.controller.metrics import (
+        AttainmentInstruments,
         CycleInstruments,
         ForecastInstruments,
         MetricsEmitter,
@@ -45,6 +73,7 @@ def build_controller_registry():
     MetricsEmitter(registry)
     CycleInstruments(registry)
     ForecastInstruments(registry)
+    AttainmentInstruments(registry)
     return registry
 
 

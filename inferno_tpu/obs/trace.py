@@ -125,13 +125,21 @@ class TraceBuffer:
         self._seq = 0
 
     def append(self, doc: dict[str, Any]) -> int:
+        # the stamp is written AFTER the document spread: a doc that
+        # already carries a "seq" key (e.g. a recorded cycle replayed
+        # back through a buffer) must not override the monotonic stamp —
+        # readers detect missed cycles by seq gaps, and a stale embedded
+        # seq would fake gaps or reversals under concurrent polling
         with self._lock:
             self._seq += 1
-            self._items.append({"seq": self._seq, **doc})
+            self._items.append({**doc, "seq": self._seq})
             return self._seq
 
     def snapshot(self) -> list[dict[str, Any]]:
-        """Oldest-first copy of the retained traces."""
+        """Oldest-first copy of the retained traces. Documents are
+        append-once (the buffer never mutates them after `append`
+        returns), so the locked list copy is a consistent view even
+        while another thread keeps appending."""
         with self._lock:
             return list(self._items)
 

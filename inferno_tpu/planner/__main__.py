@@ -13,6 +13,11 @@ Examples::
 
     # reactive vs forecast-bound sizing side by side
     python -m inferno_tpu.planner --variants 100 --steps 48 --forecast
+
+    # replay a RECORDED production trace (flight-recorder artifact,
+    # env FLIGHT_RECORDER_DIR on the live controller) instead of
+    # synthetic generators; --forecast works over the real history too
+    python -m inferno_tpu.planner --trace /var/lib/inferno/recorder
 """
 
 from __future__ import annotations
@@ -50,6 +55,17 @@ def main(argv=None) -> int:
         prog="python -m inferno_tpu.planner",
         description="Offline fleet capacity planner: batched scenario replay",
     )
+    ap.add_argument("--trace", default="",
+                    help="replay a RECORDED flight-recorder artifact "
+                         "(obs/recorder.py directory) instead of synthetic "
+                         "scenarios; the fleet is reconstructed from the "
+                         "recording's own snapshot and drift/parity are "
+                         "reported (docs/observability.md)")
+    ap.add_argument("--trace-rate-field", default="sizing_rpm",
+                    choices=("sizing_rpm", "arrival_rpm"),
+                    help="which recorded per-variant rate drives the "
+                         "replay: the rate sizing actually ran against "
+                         "(default) or the raw observed arrival rate")
     ap.add_argument("--variants", type=int, default=200,
                     help="synthetic fleet size (testing.fleet.fleet_system_spec)")
     ap.add_argument("--shapes", type=int, default=2,
@@ -93,6 +109,9 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="",
                     help="write the JSON report here instead of stdout")
     args = ap.parse_args(argv)
+
+    if args.trace:
+        return _replay_trace(args)
 
     import numpy as np
 
@@ -154,6 +173,90 @@ def main(argv=None) -> int:
             )
             for trace in traces
         ],
+    }
+    text = json.dumps(report, indent=1)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+def _replay_trace(args) -> int:
+    """--trace mode: recorded-artifact replay (ROADMAP item 3's
+    remaining bullet). The fleet System is reconstructed from the
+    recording's latest snapshot; drift names variants added/removed
+    relative to it, and choice/replica parity is checked at sampled
+    cycles (first / middle / last)."""
+    from inferno_tpu.obs.recorder import read_artifact
+    from inferno_tpu.planner.replay import (
+        replay_cycle_parity,
+        replay_recorded,
+        system_from_recorded,
+    )
+
+    backend = _resolve_backend(args.backend)
+    recorded = read_artifact(args.trace)
+    if not recorded.cycles:
+        raise SystemExit(f"no recorded cycles in {args.trace!r}")
+    # anchor the replay fleet on the NEWEST cycle whose snapshot
+    # resolves — a damaged/rotated artifact can carry cycles whose
+    # fingerprint resolves nowhere (the same state the parity loop below
+    # reports as skip_reason), and that must degrade, not KeyError
+    anchor = next(
+        (k for k in range(recorded.num_cycles - 1, -1, -1)
+         if recorded.cycles[k].fingerprint in recorded.snapshots),
+        None,
+    )
+    if anchor is None:
+        raise SystemExit(
+            f"{args.trace!r} carries no resolvable fleet snapshot; cannot "
+            "reconstruct a System to replay against"
+        )
+    system = system_from_recorded(recorded, anchor)
+    # T=1 parity at sampled cycles, each against its OWN snapshot; a
+    # sample whose snapshot was lost (rotated away, damaged) is reported
+    # as skipped — an empty or partial parity list must never read as a
+    # vacuous clean pass
+    parity_sampled = []
+    for k in recorded.sampled_cycles():
+        if recorded.cycles[k].fingerprint in recorded.snapshots:
+            parity_sampled.append(
+                replay_cycle_parity(recorded, k, backend=backend)
+            )
+        else:
+            parity_sampled.append({
+                "cycle_index": k,
+                "skip_reason": "snapshot unavailable (rotated away or damaged)",
+                "match": None,
+            })
+    report = {
+        "trace_dir": recorded.dir,
+        "schema_version": recorded.schema_version,
+        "read_warnings": list(recorded.warnings),
+        "fleet": {
+            "variants": len(system.servers),
+            "backend": backend,
+            "capacity_chips": dict(system.capacity),
+            "quotas": dict(system.quotas),
+            "snapshot_fingerprint": recorded.cycles[anchor].fingerprint,
+            "snapshot_cycle_index": anchor,
+            "snapshots": len(recorded.snapshots),
+        },
+        "steps": recorded.num_cycles,
+        "step_seconds": recorded.step_seconds(),
+        "recorded": replay_recorded(
+            system, recorded,
+            backend=backend,
+            rate_field=args.trace_rate_field,
+            chunk_steps=args.chunk_steps,
+            include_series=args.series,
+            forecast=args.forecast,
+            forecast_horizon_s=args.forecast_horizon_s,
+        ),
+        "parity_sampled": parity_sampled,
     }
     text = json.dumps(report, indent=1)
     if args.out:

@@ -30,6 +30,18 @@ replaced by max(observed, forecast upper band) — the reconciler's
 forecast-bound sizing rule (`forecast.ArrivalForecaster`) applied
 offline — so reactive vs forecast-bound capacity needs sit side by side
 in one report.
+
+RECORDED traces (ISSUE-10): `replay_recorded` turns a flight-recorder
+artifact (`obs/recorder.py`, env FLIGHT_RECORDER_DIR on the live
+controller) into the same [T, S] rate matrix and replays it against a
+fleet System — by default the one reconstructed bit-faithfully from the
+recording's own fleet snapshot (`system_from_recorded`), or any live
+snapshot the caller supplies. Recorded variants are joined to the
+fleet's servers on variant id; added/removed variants land in an
+explicit drift report instead of silently vanishing. A recorded T=1
+cycle replayed against its own snapshot reproduces the live
+`calculate_fleet` decision bit-identically (`replay_cycle_parity`,
+pinned in tests and asserted by `make bench-recorder`).
 """
 
 from __future__ import annotations
@@ -39,6 +51,11 @@ import numpy as np
 from inferno_tpu.parallel.fleet import FleetBatchResult, calculate_fleet_batch
 from inferno_tpu.planner.scenarios import ScenarioTrace
 from inferno_tpu.solver.greedy_vec import capacity_buckets
+
+# decisions that never correspond to an unconstrained solve output: the
+# parity check skips them (stabilization holds actuate a gated count,
+# capacity degradation is the limited-mode ladder, errors decided nothing)
+PARITY_SKIP_REASONS = frozenset({"error", "stabilization_hold", "capacity_limited"})
 
 
 def forecast_bound_rates(
@@ -256,6 +273,147 @@ def aggregate_replay(
             ),
         },
         "cost": cost,
+    }
+
+
+# -- recorded-trace replay (flight-recorder artifacts) ------------------------
+
+
+def system_from_recorded(recorded, cycle_index: int = -1):
+    """Reconstruct the fleet System from the snapshot a recorded cycle's
+    solve consumed (`SystemSpec.from_dict` of the recorded document —
+    the same round-trip the ConfigMap path uses, so profiles incl.
+    corrector output, SLOs, token mixes, and current allocations are
+    bit-faithful)."""
+    from inferno_tpu.config.types import SystemSpec
+    from inferno_tpu.core import System
+
+    return System(SystemSpec.from_dict(recorded.spec_doc_for(cycle_index)))
+
+
+def recorded_rates(
+    recorded, server_names: list[str], rate_field: str = "sizing_rpm"
+) -> tuple[np.ndarray, dict]:
+    """[T, S] rate matrix of a RecordedTrace aligned to `server_names`
+    (the fleet System's server order), plus the drift report.
+
+    `rate_field` is "sizing_rpm" (the λ sizing actually ran against —
+    includes the forecast bound when predictive scaling was on) or
+    "arrival_rpm" (the raw observed λ). A fleet server absent from a
+    recorded cycle replays at rate 0 that step; both directions of
+    membership drift are reported explicitly."""
+    rates, present = recorded.column_matrix(rate_field, server_names)
+    recorded_ids = set(recorded.variant_ids())
+    fleet_ids = set(server_names)
+    n_steps = len(recorded.cycles)
+    coverage = float(present.mean()) if present.size else 0.0
+    return rates, {
+        "recorded_cycles": n_steps,
+        "rate_field": rate_field,
+        # variants in the fleet snapshot the recording never saw (added
+        # since recording) and recorded variants missing from the fleet
+        # (removed since recording)
+        "added_variants": sorted(fleet_ids - recorded_ids),
+        "removed_variants": sorted(recorded_ids - fleet_ids),
+        "matched_variants": len(fleet_ids & recorded_ids),
+        # fraction of (cycle, fleet-server) slots a recorded rate existed
+        # for — 1.0 means every fleet server was recorded every cycle
+        "coverage": round(coverage, 6),
+    }
+
+
+def replay_recorded(
+    system,
+    recorded,
+    backend: str = "jax",
+    rate_field: str = "sizing_rpm",
+    chunk_steps: int | None = None,
+    include_series: bool = False,
+    forecast: bool = False,
+    forecast_horizon_s: float | None = None,
+    forecast_config=None,
+) -> dict:
+    """Replay a recorded artifact against `system` (the current fleet
+    snapshot): same report shape as a synthetic scenario — per-pool /
+    per-quota demand, first binds, cost bands, optional forecast-bound
+    pass over the real history — plus the variant-drift block."""
+    names = list(system.servers)
+    rates, drift = recorded_rates(recorded, names, rate_field)
+    trace = ScenarioTrace(
+        name="recorded",
+        rates=rates,
+        step_seconds=recorded.step_seconds(),
+        seed=0,
+        description=f"flight-recorder artifact {recorded.dir}",
+    )
+    out = replay_scenario(
+        system, trace,
+        backend=backend,
+        chunk_steps=chunk_steps,
+        include_series=include_series,
+        forecast=forecast,
+        forecast_horizon_s=forecast_horizon_s,
+        forecast_config=forecast_config,
+    )
+    out["drift"] = drift
+    out["source"] = "recorded"
+    return out
+
+
+def replay_cycle_parity(
+    recorded, cycle_index: int, backend: str = "jax", system=None
+) -> dict:
+    """Replay ONE recorded cycle (T=1) against its own fleet snapshot
+    and compare the replayed choice/replicas with the recorded live
+    decisions. With a faithful snapshot this is bit-identical for every
+    unconstrained decision (`calculate_fleet_batch` T=1 ≡ the live
+    `calculate_fleet` + `solve_unlimited`, tests/test_planner.py);
+    records with reasons in PARITY_SKIP_REASONS are skipped and
+    counted."""
+    cyc = recorded.cycles[cycle_index]
+    if system is None:
+        system = system_from_recorded(recorded, cycle_index)
+    names = list(system.servers)
+    idx = {v: j for j, v in enumerate(names)}
+    rates = np.zeros((1, len(names)), np.float64)
+    for j, v in enumerate(cyc.variants):
+        if v in idx:
+            rates[0, idx[v]] = float(cyc.columns["sizing_rpm"][j])
+    result = calculate_fleet_batch(system, rates, backend=backend)
+    mismatches: list[dict] = []
+    compared = skipped = missing = 0
+    for j, v in enumerate(cyc.variants):
+        if v not in idx:
+            missing += 1
+            continue
+        reason = str(cyc.columns["reason"][j])
+        if reason in PARITY_SKIP_REASONS:
+            skipped += 1
+            continue
+        compared += 1
+        s = idx[v]
+        choice = int(result.choice[0, s])
+        replayed_acc = result.accelerators[choice] if choice >= 0 else ""
+        replayed_reps = int(result.replicas[0, s])
+        rec_acc = str(cyc.columns["accelerator"][j])
+        rec_reps = int(cyc.columns["replicas"][j])
+        if replayed_acc != rec_acc or replayed_reps != rec_reps:
+            mismatches.append({
+                "variant": v,
+                "reason": reason,
+                "recorded": {"accelerator": rec_acc, "replicas": rec_reps},
+                "replayed": {
+                    "accelerator": replayed_acc, "replicas": replayed_reps
+                },
+            })
+    return {
+        "cycle_index": cycle_index,
+        "seq": cyc.seq,
+        "compared": compared,
+        "skipped": skipped,
+        "missing_from_snapshot": missing,
+        "mismatches": mismatches,
+        "match": not mismatches,
     }
 
 

@@ -446,3 +446,23 @@ def test_reconcile_cycle_bench_smoke():
     assert block["optimized"]["sizing_cache_hits"] == 8  # 2nd cycle replayed
     assert block["speedup"] > 0
     assert "miniprom" in block["provenance"]
+
+
+def test_flight_recorder_bench_smoke():
+    """The ISSUE-10 recorder benchmark at toy scale: recording drops
+    nothing, the artifact replays with parity at every sampled cycle,
+    and the block carries the compact-line keys. The overhead budget is
+    relaxed here — at toy cycle times (a few ms) scheduler noise between
+    the on/off runs dwarfs the enqueue cost the 3% production budget
+    bounds (make bench-recorder runs the honest 200-variant version)."""
+    block = bench.flight_recorder_bench(
+        n_variants=5, cycles=3, overhead_budget_pct=100.0
+    )
+    assert block["dropped"] == 0
+    assert block["snapshots"] >= 1
+    assert block["artifact_bytes"] > 0
+    assert [p["match"] for p in block["parity"]] == [True] * len(block["parity"])
+    assert all(p["compared"] == 5 for p in block["parity"])
+    assert block["recorder_replay_ms"] > 0
+    assert "recorder_overhead_pct" in block
+    assert "jax-backend" in block["provenance"]

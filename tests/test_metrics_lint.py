@@ -10,10 +10,11 @@ def test_production_catalog_is_clean():
     registry = build_controller_registry()
     names = {name for name, _, _ in registry.catalog()}
     # the four actuation series, the four cycle-latency histograms, the
-    # three predictive-scaling forecast gauges, and the three fleet-scale
+    # three predictive-scaling forecast gauges, the three fleet-scale
     # cycle instruments (query counter, cache-lookup gauge,
-    # collect-concurrency histogram)
-    assert len(names) == 14
+    # collect-concurrency histogram), the flight-recorder drop counter,
+    # and the four attainment/model-error scoreboard gauges
+    assert len(names) == 19
     assert {"inferno_desired_replicas", "inferno_cycle_duration_seconds",
             "inferno_variant_analysis_seconds", "inferno_solver_seconds",
             "inferno_prom_scrape_seconds"} <= names
@@ -54,13 +55,54 @@ def test_forecast_series_in_catalog():
 
 def test_lint_flags_missing_prefix_and_help():
     registry = Registry()
-    registry.gauge("inferno_good", "has help")
-    registry.gauge("rogue_series", "has help")  # wrong prefix
+    registry.gauge("inferno_good_ratio", "has help")
+    registry.gauge("rogue_series_total", "has help")  # wrong prefix
     registry.histogram("inferno_silent_seconds", "")  # empty help
     violations = lint_registry(registry)
     assert len(violations) == 2
     assert any("rogue_series" in v and "prefix" in v for v in violations)
     assert any("inferno_silent_seconds" in v and "help" in v for v in violations)
+
+
+def test_attainment_and_recorder_series_in_catalog():
+    """The ISSUE-10 scoreboard gauges and the recorder drop counter ride
+    the same enforcement and register unconditionally (the catalog must
+    not depend on whether FLIGHT_RECORDER_DIR is set)."""
+    registry = build_controller_registry()
+    catalog = {name: (help_, kind) for name, help_, kind in registry.catalog()}
+    expected = {
+        "inferno_model_error_ttft_ms": "gauge",
+        "inferno_model_error_itl_ms": "gauge",
+        "inferno_slo_attainment_ratio": "gauge",
+        "inferno_error_budget_burn_ratio": "gauge",
+        "inferno_recorder_dropped_total": "counter",
+    }
+    for name, kind in expected.items():
+        assert name in catalog, name
+        help_, got_kind = catalog[name]
+        assert got_kind == kind
+        assert help_.strip()
+
+
+def test_lint_enforces_unit_suffix_with_allowlist():
+    """ISSUE-10 satellite: every series name must end in a unit suffix
+    (_seconds/_ms/_total/_ratio/_rpm) unless grandfathered."""
+    from inferno_tpu.obs.lint import UNIT_SUFFIX_ALLOWLIST
+
+    registry = Registry()
+    registry.gauge("inferno_mystery_value", "has help")  # no unit suffix
+    registry.gauge("inferno_latency_ms", "has help")  # suffixed: clean
+    registry.gauge("inferno_collect_concurrency", "has help")  # grandfathered
+    violations = lint_registry(registry)
+    assert len(violations) == 1
+    assert "inferno_mystery_value" in violations[0]
+    assert "unit suffix" in violations[0]
+    # the allowlist is a closed, known set — additions need a
+    # contract-level reason, so pin its membership here
+    assert UNIT_SUFFIX_ALLOWLIST == {
+        "inferno_desired_replicas", "inferno_current_replicas",
+        "inferno_sizing_cache_lookups", "inferno_collect_concurrency",
+    }
 
 
 def test_lint_cli_exit_code():

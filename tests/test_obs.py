@@ -90,6 +90,68 @@ def test_trace_buffer_bounded_with_monotonic_seq():
         TraceBuffer(capacity=0)
 
 
+def test_trace_buffer_stamp_survives_embedded_seq():
+    """A document already carrying a "seq" key (a recorded cycle
+    replayed back through a buffer) must NOT override the monotonic
+    stamp — readers detect missed cycles by seq gaps, and a stale
+    embedded value fakes gaps or reversals."""
+    buf = TraceBuffer(capacity=4)
+    for i in range(3):
+        buf.append({"i": i, "seq": 999})  # hostile embedded seq
+    assert [d["seq"] for d in buf.snapshot()] == [1, 2, 3]
+
+
+def test_trace_buffer_concurrent_append_read_stress():
+    """The reconcile thread appends while the debug route iterates: every
+    snapshot must show strictly-consecutive monotonic seqs (no gaps, no
+    tears) and stay JSON-serializable mid-append."""
+    import threading
+
+    buf = TraceBuffer(capacity=8)
+    stop = threading.Event()
+    writer_errors: list[BaseException] = []
+
+    def writer():
+        i = 0
+        try:
+            while not stop.is_set():
+                # embedded "seq" exercises the stamp-priority fix under
+                # concurrency too
+                buf.append({"i": i, "seq": 12345, "payload": {"n": i}})
+                i += 1
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            writer_errors.append(e)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        snapshots = 0
+        last_seen = 0
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            snap = buf.snapshot()
+            if not snap:
+                continue
+            seqs = [d["seq"] for d in snap]
+            # monotonic AND gapless within one snapshot: a torn view
+            # (append racing the copy) would show a jump or repeat
+            assert seqs == list(range(seqs[0], seqs[0] + len(seqs))), seqs
+            # never goes backwards across snapshots
+            assert seqs[-1] >= last_seen
+            last_seen = seqs[-1]
+            # each doc is internally consistent (i stamped before append)
+            for d in snap:
+                assert d["payload"]["n"] == d["i"]
+            json.dumps(snap)  # serializable mid-append
+            snapshots += 1
+    finally:
+        stop.set()
+        t.join(timeout=3.0)
+    assert not writer_errors
+    assert snapshots > 100  # the loop genuinely raced the writer
+    assert last_seen > 100
+
+
 def test_decision_record_rejects_unknown_reason():
     with pytest.raises(ValueError):
         DecisionRecord(variant="v", reason="because")
@@ -448,6 +510,216 @@ def test_debug_decisions_route_serves_last_k_cycles():
             with pytest.raises(urllib.error.HTTPError) as exc:
                 urllib.request.urlopen(
                     f"http://127.0.0.1:{bare.port}/debug/decisions", timeout=10
+                )
+            assert exc.value.code == 404
+        finally:
+            bare.stop()
+    finally:
+        server.stop()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.load(resp)
+
+
+def test_debug_decisions_query_filters():
+    """ISSUE-10 satellite: ?variant= and ?cycles= narrow the ring so a
+    large-fleet trace is inspectable without downloading everything;
+    invalid parameters are a 400, never a silent full dump."""
+    import copy
+
+    cluster = make_cluster(replicas=1)
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    va2 = copy.deepcopy(va)
+    va2.name = "llama-second"
+    cluster.add_variant_autoscaling(va2)
+    cluster.add_deployment(NS, "llama-second", replicas=1)
+    traces = TraceBuffer(capacity=8)
+    rec = Reconciler(
+        kube=cluster, prom=make_prom(arrival_rps=50.0),
+        config=ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar"),
+        trace_buffer=traces,
+    )
+    server = MetricsServer(rec.emitter.registry, port=0, traces=traces)
+    server.start()
+    try:
+        for _ in range(3):
+            rec.run_cycle()
+        base = f"http://127.0.0.1:{server.port}/debug/decisions"
+
+        doc = _get_json(base + "?cycles=1")
+        assert len(doc["cycles"]) == 1
+        assert doc["cycles"][0]["seq"] == 3
+        assert len(doc["cycles"][0]["decisions"]) == 2  # both variants
+
+        doc = _get_json(base + "?variant=llama-second:workloads&cycles=2")
+        assert len(doc["cycles"]) == 2
+        for cyc in doc["cycles"]:
+            assert [d["variant"] for d in cyc["decisions"]] == [
+                "llama-second:workloads"
+            ]
+            # the fleet-wide span tree is omitted from filtered views
+            assert "spans" not in cyc
+            assert "seq" in cyc and "optimization_ok" in cyc
+
+        # a variant that never reported: cycles kept, decisions empty
+        doc = _get_json(base + "?variant=nope:ns")
+        assert all(cyc["decisions"] == [] for cyc in doc["cycles"])
+
+        for bad in ("?cycles=abc", "?cycles=0", "?cycles=-2", "?foo=1",
+                    "?variant="):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + bad, timeout=10)
+            assert exc.value.code == 400, bad
+            assert "error" in json.load(exc.value)
+    finally:
+        server.stop()
+
+
+# -- attainment scoreboard ---------------------------------------------------
+
+
+def test_attainment_tracker_scores_prediction_against_next_observation():
+    from inferno_tpu.obs import AttainmentConfig, AttainmentTracker
+
+    tr = AttainmentTracker(AttainmentConfig(ewma_gain=0.5, slo_objective=0.9))
+    # cycle 1: a prediction is stored; nothing to score yet
+    s = tr.observe("v", predicted_ttft_ms=100.0, predicted_itl_ms=10.0,
+                   observed_ttft_ms=120.0, observed_itl_ms=9.0,
+                   slo_ttft_ms=150.0, slo_itl_ms=12.0)
+    assert s.ttft_error_ms is None and s.itl_error_ms is None
+    assert s.scored_cycles == 0
+    assert s.ttft_attainment == 1.0  # 120 <= 150
+    # cycle 2: cycle 1's prediction scored against cycle 2's observation
+    s = tr.observe("v", predicted_ttft_ms=100.0, predicted_itl_ms=10.0,
+                   observed_ttft_ms=130.0, observed_itl_ms=8.0,
+                   slo_ttft_ms=150.0, slo_itl_ms=12.0)
+    assert s.ttft_error_ms == pytest.approx(30.0)  # 130 observed - 100 predicted
+    assert s.itl_error_ms == pytest.approx(-2.0)
+    assert s.ttft_error_ewma_ms == pytest.approx(30.0)  # seeded
+    assert s.scored_cycles == 1
+    # cycle 3: EWMA folds at gain 0.5; a breach moves attainment down
+    s = tr.observe("v", predicted_ttft_ms=100.0, predicted_itl_ms=10.0,
+                   observed_ttft_ms=200.0, observed_itl_ms=8.0,
+                   slo_ttft_ms=150.0, slo_itl_ms=12.0)
+    assert s.ttft_error_ms == pytest.approx(100.0)
+    assert s.ttft_error_ewma_ms == pytest.approx(0.5 * 100 + 0.5 * 30)
+    assert s.ttft_attainment == pytest.approx(0.5 * 0.0 + 0.5 * 1.0)
+    # burn = (1 - min attainment) / (1 - objective) = 0.5 / 0.1
+    assert s.burn_rate == pytest.approx(5.0)
+
+    # missing telemetry neither scores nor corrupts state
+    s = tr.observe("v", predicted_ttft_ms=0.0, predicted_itl_ms=0.0,
+                   observed_ttft_ms=0.0, observed_itl_ms=0.0,
+                   slo_ttft_ms=150.0, slo_itl_ms=12.0)
+    assert s.ttft_error_ms is None
+    assert s.ttft_error_ewma_ms == pytest.approx(65.0)  # unchanged
+
+    tr.prune(set())
+    assert tr.score_of("v") is None
+
+
+def test_attainment_unconstrained_dimension_stays_none():
+    from inferno_tpu.obs import AttainmentTracker
+
+    tr = AttainmentTracker()
+    s = tr.observe("v", predicted_ttft_ms=10.0, predicted_itl_ms=10.0,
+                   observed_ttft_ms=10.0, observed_itl_ms=10.0,
+                   slo_ttft_ms=0.0, slo_itl_ms=20.0)  # no TTFT SLO
+    assert s.ttft_attainment is None
+    assert s.itl_attainment == 1.0
+    assert s.burn_rate == 0.0  # fully attained on the only bound dimension
+
+
+def test_model_error_gauges_gated_per_dimension():
+    """A variant whose engine reports only ITL telemetry must not
+    publish a 0.0 "perfect model" TTFT error gauge — each dimension's
+    gauge emits only once that dimension has scored."""
+    from inferno_tpu.controller.metrics import AttainmentInstruments
+    from inferno_tpu.obs import AttainmentTracker
+
+    tr = AttainmentTracker()
+    inst = AttainmentInstruments(Registry())
+    for _ in range(2):  # second observe scores ITL only
+        s = tr.observe("v", predicted_ttft_ms=10.0, predicted_itl_ms=10.0,
+                       observed_ttft_ms=0.0, observed_itl_ms=12.0,
+                       slo_ttft_ms=100.0, slo_itl_ms=20.0)
+    assert s.itl_error_scored and not s.ttft_error_scored
+    inst.set_score("ns", "v", s)
+    body = inst.registry.render()
+    assert 'inferno_model_error_itl_ms{namespace="ns"' in body
+    assert 'inferno_model_error_ttft_ms{namespace="ns"' not in body
+
+
+def test_reconciler_stamps_model_error_fields_and_gauges():
+    """From the second cycle on, the DecisionRecord carries observed -
+    predicted model error and its EWMA, and the scoreboard gauges render
+    on /metrics."""
+    cluster = make_cluster(replicas=1)
+    rec = reconciler(cluster, make_prom(arrival_rps=50.0))
+    r1 = rec.run_cycle()
+    (d1,) = r1.decisions
+    assert d1.ttft_model_error_ms == 0.0  # nothing to score yet
+    r2 = rec.run_cycle()
+    (d2,) = r2.decisions
+    # FakeProm telemetry is static: error = observed - cycle-1 prediction
+    assert d2.ttft_model_error_ms == pytest.approx(
+        d2.ttft_observed_ms - d1.ttft_predicted_ms
+    )
+    assert d2.itl_model_error_ms == pytest.approx(
+        d2.itl_observed_ms - d1.itl_predicted_ms
+    )
+    assert d2.ttft_model_error_ewma_ms == pytest.approx(
+        abs(d2.ttft_model_error_ms)
+    )
+    body = rec.emitter.registry.render()
+    for name in ("inferno_model_error_ttft_ms", "inferno_model_error_itl_ms",
+                 "inferno_error_budget_burn_ratio"):
+        assert f'{name}{{namespace="{NS}",variant_name="llama-premium"}}' in body
+    assert 'inferno_slo_attainment_ratio{dimension="itl"' in body
+
+
+def test_attainment_series_pruned_with_variant():
+    cluster = make_cluster(replicas=1)
+    rec = reconciler(cluster, make_prom(arrival_rps=50.0))
+    rec.run_cycle()
+    rec.run_cycle()
+    assert "inferno_model_error_ttft_ms{" in rec.emitter.registry.render()
+    cluster._vas.clear()
+    rec.run_cycle()
+    body = rec.emitter.registry.render()
+    assert 'variant_name="llama-premium"' not in "".join(
+        ln for ln in body.splitlines()
+        if ln.startswith(("inferno_model_error", "inferno_slo_attainment",
+                          "inferno_error_budget_burn"))
+    )
+    assert rec.attainment.score_of("llama-premium:workloads") is None
+
+
+def test_debug_attainment_endpoint():
+    cluster = make_cluster(replicas=1)
+    rec = reconciler(cluster, make_prom(arrival_rps=50.0))
+    server = MetricsServer(
+        rec.emitter.registry, port=0, attainment=rec.attainment
+    )
+    server.start()
+    try:
+        rec.run_cycle()
+        rec.run_cycle()
+        doc = _get_json(f"http://127.0.0.1:{server.port}/debug/attainment")
+        assert doc["ewma_gain"] == pytest.approx(0.2)
+        row = doc["variants"]["llama-premium:workloads"]
+        assert row["scored_cycles"] == 1
+        assert row["itl_attainment"] is not None
+        assert row["itl_error_ewma_ms"] >= 0.0
+        # without a tracker the route does not exist
+        bare = MetricsServer(Registry(), port=0)
+        bare.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{bare.port}/debug/attainment", timeout=10
                 )
             assert exc.value.code == 404
         finally:
