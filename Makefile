@@ -6,8 +6,8 @@ IMG ?= inferno-tpu-autoscaler:latest
 CLUSTER ?= inferno-tpu
 
 .PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
-        bench-sizing bench-capacity bench-planner bench-recorder native \
-        lint lint-metrics \
+        bench-sizing bench-capacity bench-planner bench-recorder \
+        bench-spot native lint lint-metrics \
         manifests-sync docker-build deploy-kind deploy undeploy clean
 
 all: native test
@@ -69,6 +69,13 @@ bench-cycle:
 # bench_full.json
 bench-recorder:
 	$(PYTHON) bench.py --recorder
+
+# Spot-market eviction-storm benchmark (ISSUE-11): risk-blind
+# spot-greedy vs pre-positioned reserved headroom on the canonical
+# correlated-reclaim storm; ASSERTS the pre-positioner cuts
+# violation-seconds at <= 10% cost overhead; recorded in bench_full.json
+bench-spot:
+	$(PYTHON) bench.py --spot
 
 # Build the native C++ solver in place (also built on demand at import).
 native:

@@ -758,6 +758,125 @@ def flight_recorder_bench(
         shutil.rmtree(trace_dir, ignore_errors=True)
 
 
+def spot_storm_bench(
+    n_variants: int = 200,
+    steps: int = 48,
+    step_seconds: float = 600.0,
+    backend: str | None = None,
+) -> dict:
+    """Spot-market economics under a canonical correlated eviction storm
+    (ISSUE-11, `make bench-spot`).
+
+    Fleet level: an N-variant diurnal trace replays through
+    `calculate_fleet_batch` twice — the risk-blind spot-greedy baseline
+    (risk penalty zeroed: every price-eligible replica rides the
+    discount, nothing pre-positioned) and the configured risk model with
+    reserved-headroom pre-positioning — then the same seeded
+    `spot_reclaim` storm schedule is evaluated against both placements
+    (spot/scenarios.py). The canonical tier (30% discount, 6% blast
+    radius, hazard below the all-spot boundary) keeps both runs on the
+    same spot placement, so the comparison isolates exactly what the
+    pre-positioner buys: evictions that fail over onto held headroom
+    instead of riding out the full recovery window.
+
+    A deterministic closed-loop comparison (spot/injection.py: the
+    autoscale plant with mid-run replica kills) rides along as the
+    emulator-side view of the same storm.
+
+    ASSERTED (acceptance, ISSUE-11): pre-positioning strictly reduces
+    violation-seconds, at a cost overhead of at most 10% over the
+    risk-blind baseline. Compact-line keys: spot_violation_s_reactive,
+    spot_violation_s_prepositioned, spot_cost_delta_pct."""
+    import dataclasses as dc
+
+    import jax
+
+    from inferno_tpu.config.types import CapacitySpec, SpotPoolSpec
+    from inferno_tpu.core import System
+    from inferno_tpu.parallel import reset_fleet_state
+    from inferno_tpu.planner.scenarios import base_rates_from_system, diurnal
+    from inferno_tpu.spot.injection import run_spot_storm_comparison
+    from inferno_tpu.spot.scenarios import build_storms, replay_spot_storm
+    from inferno_tpu.testing.fleet import fleet_system_spec
+
+    if backend is None:
+        backend = "tpu" if jax.default_backend() == "tpu" else "jax"
+
+    # the canonical tier: premium 0.005 x 0.06 x 0.5h x 1000 = 0.15 <
+    # 0.3 discount, so the risk model keeps the whole fleet on spot and
+    # the pre-positioned run differs by exactly the held headroom
+    tier = SpotPoolSpec(
+        discount=0.3, hazard_per_hr=0.005, blast_radius=0.06,
+        recovery_s=1800.0,
+    )
+    reset_fleet_state()
+    spec = fleet_system_spec(n_variants, shapes_per_variant=2)
+    spec.capacity = CapacitySpec(chips={}, spot={"v5e": tier})
+    system = System(spec)
+    trace = diurnal(
+        base_rates_from_system(system), steps, step_seconds, seed=0
+    )
+    storm = build_storms(["spot_reclaim"], ["v5e"], steps, step_seconds, seed=7)[0]
+    # pin the realized reclaim inside the configured blast radius: the
+    # canonical storm is the one the operator provisioned for
+    storm = dc.replace(storm, events=tuple(
+        dc.replace(e, fraction=min(e.fraction, tier.blast_radius))
+        for e in storm.events
+    ))
+
+    t0 = time.perf_counter()
+    report = replay_spot_storm(
+        spec, trace, storm, backend=backend
+    )
+    replay_ms = (time.perf_counter() - t0) * 1000.0
+    reset_fleet_state()
+
+    reactive = report["reactive"]
+    prepos = report["prepositioned"]
+    # acceptance: the pre-positioner must strictly cut violation-seconds
+    # at <= 10% cost overhead — a silent regression here would unsell
+    # the whole subsystem
+    if not (prepos["violation_seconds"] < reactive["violation_seconds"]):
+        raise RuntimeError(
+            "pre-positioned headroom did not reduce violation-seconds: "
+            f"{prepos['violation_seconds']} vs {reactive['violation_seconds']}"
+        )
+    if not (0.0 < report["cost_delta_pct"] <= 10.0):
+        raise RuntimeError(
+            "pre-positioned cost overhead outside (0, 10%]: "
+            f"{report['cost_delta_pct']}%"
+        )
+
+    loop = run_spot_storm_comparison()
+
+    return {
+        "backend": backend,
+        "platform": jax.default_backend(),
+        "variants": n_variants,
+        "steps": steps,
+        "step_seconds": step_seconds,
+        "tier": tier.to_dict(),
+        "storm": {
+            "name": storm.name, "seed": storm.seed,
+            "events": [dc.asdict(e) for e in storm.events],
+        },
+        "replay_ms": round(replay_ms, 1),
+        "fleet_replay": report,
+        "closed_loop": loop,
+        # the compact line's keys
+        "spot_violation_s_reactive": reactive["violation_seconds"],
+        "spot_violation_s_prepositioned": prepos["violation_seconds"],
+        "spot_cost_delta_pct": report["cost_delta_pct"],
+        "meets_overhead_bound": report["cost_delta_pct"] <= 10.0,
+        "provenance": (
+            f"{backend} backend on {jax.default_backend()}; diurnal trace, "
+            "risk-blind vs pre-positioned placements evaluated against the "
+            "same seeded correlated-reclaim schedule; closed-loop plant "
+            "comparison deterministic (no threads, no RNG)"
+        ),
+    }
+
+
 def sizing_scaling_bench(
     sizes: tuple[int, ...] = (200, 1000, 3000, 10000),
     repeats: int = 4,
@@ -1732,7 +1851,8 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
                        sizing: dict | None = None,
                        capacity: dict | None = None,
                        planner: dict | None = None,
-                       recorder: dict | None = None) -> dict:
+                       recorder: dict | None = None,
+                       spot: dict | None = None) -> dict:
     """Everything the bench measures, in one document — written to
     `bench_full.json`, NOT printed (the printed line is `compact_line`)."""
     return {
@@ -1801,12 +1921,19 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
         # (ISSUE-10): a 200-variant 30-cycle MiniProm run recorded and
         # replayed through the planner
         **({"recorder": recorder} if recorder else {}),
+        # spot-market eviction storm (ISSUE-11): risk-blind spot-greedy
+        # vs pre-positioned reserved headroom on the canonical
+        # correlated-reclaim schedule, fleet replay + closed loop
+        **({"spot": spot} if spot else {}),
     }
 
 
 # optional `extra` fields in drop order on a 1024-byte overflow: least
 # headline-critical first (the full payload always carries everything)
 _COMPACT_DROP_ORDER = (
+    "spot_violation_s_reactive",
+    "spot_violation_s_prepositioned",
+    "spot_cost_delta_pct",
     "recorder_overhead_pct",
     "recorder_replay_ms",
     "planner_week_ms",
@@ -1837,7 +1964,8 @@ def compact_line(ns: dict, cycles: dict, tpu_probe: dict,
                  sizing: dict | None = None,
                  capacity: dict | None = None,
                  planner: dict | None = None,
-                 recorder: dict | None = None) -> str:
+                 recorder: dict | None = None,
+                 spot: dict | None = None) -> str:
     """The ONE printed JSON line. Round-4 postmortem: the driver captures
     only a tail window of stdout, and round 4's ~4 KB single line was cut
     mid-object (`BENCH_r04.json parsed: null`) — a benchmark whose number
@@ -1873,6 +2001,11 @@ def compact_line(ns: dict, cycles: dict, tpu_probe: dict,
         **({"recorder_overhead_pct": recorder["recorder_overhead_pct"],
             "recorder_replay_ms": recorder["recorder_replay_ms"]}
            if recorder and "recorder_overhead_pct" in recorder else {}),
+        **({"spot_violation_s_reactive": spot["spot_violation_s_reactive"],
+            "spot_violation_s_prepositioned":
+                spot["spot_violation_s_prepositioned"],
+            "spot_cost_delta_pct": spot["spot_cost_delta_pct"]}
+           if spot and "spot_violation_s_reactive" in spot else {}),
         **({"p99_ttft_measured_ms": measured_p99["p99_ttft_ms"],
             "p99_meets_slo": measured_p99["meets_slo"]}
            if measured_p99 else {}),
@@ -1946,6 +2079,13 @@ def main() -> None:
                          "run recorded and replayed; overhead + parity "
                          "asserted), print its JSON, and merge it into "
                          "bench_full.json")
+    ap.add_argument("--spot", action="store_true",
+                    help="run ONLY the spot-market eviction-storm benchmark "
+                         "(make bench-spot: risk-blind spot-greedy vs "
+                         "pre-positioned reserved headroom on the canonical "
+                         "correlated storm; violation cut + <=10%% cost "
+                         "overhead asserted), print its JSON, and merge it "
+                         "into bench_full.json")
     args = ap.parse_args()
     if args.cycle:
         print(json.dumps(reconcile_cycle_bench(args.cycle_variants)))
@@ -1983,6 +2123,12 @@ def main() -> None:
         recorder = flight_recorder_bench()
         merge_full("recorder", recorder)
         print(json.dumps(recorder))
+        return
+    if args.spot:
+        _pin_cpu_if_tpu_unreachable()
+        spot = spot_storm_bench()
+        merge_full("spot", spot)
+        print(json.dumps(spot))
         return
     from inferno_tpu.obs import Tracer
 
@@ -2075,6 +2221,17 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — artifact must survive
             recorder = {"error": f"{type(e).__name__}: {e}"}
             sp.set(error=str(e))
+    # spot-market eviction storm (ISSUE-11): guarded; --quick shrinks
+    # the fleet and the horizon
+    with tracer.span("spot-storm-bench") as sp:
+        try:
+            spot = spot_storm_bench(
+                n_variants=50 if args.quick else 200,
+                steps=24 if args.quick else 48,
+            )
+        except Exception as e:  # noqa: BLE001 — artifact must survive
+            spot = {"error": f"{type(e).__name__}: {e}"}
+            sp.set(error=str(e))
     Path(FULL_PAYLOAD_PATH).write_text(
         json.dumps(build_full_payload(ns, cycles, tpu_probe, measured,
                                       calibrated,
@@ -2084,11 +2241,13 @@ def main() -> None:
                                       sizing=sizing,
                                       capacity=capacity,
                                       planner=planner,
-                                      recorder=recorder),
+                                      recorder=recorder,
+                                      spot=spot),
                    indent=1) + "\n"
     )
     print(compact_line(ns, cycles, tpu_probe, measured, calibrated,
-                       reconcile_cycle, sizing, capacity, planner, recorder))
+                       reconcile_cycle, sizing, capacity, planner, recorder,
+                       spot))
 
 
 if __name__ == "__main__":

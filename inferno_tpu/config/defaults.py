@@ -45,6 +45,22 @@ ACCEL_PENALTY_FACTOR = 0.1
 # TPS target is active (reference: pkg/analyzer/queueanalyzer.go:11).
 STABILITY_SAFETY_FRACTION = 0.1
 
+# -- spot-market economics (inferno_tpu/spot/) --------------------------------
+# Objective premium per *risky* spot replica, as a multiple of the expected
+# SLO-breach replica-time it implies: a risky spot replica (one whose storm
+# eviction would push the variant below its load-required replica count)
+# carries premium = hazard/hr x blast_radius x recovery_hr x
+# SPOT_RISK_PENALTY_FACTOR x replica cost. The factor prices the *violation*,
+# not the chip-hours — losing an SLO-critical replica costs far more than the
+# hardware it ran on. With the default, risky spot wins only when
+# hazard x blast x recovery_hr x 1000 < discount.
+SPOT_RISK_PENALTY_FACTOR = 1000.0
+
+# Default replica re-provision latency after a spot eviction, seconds
+# (overridable per pool via the TPU_SPOT_POOLS `recoverySeconds` field);
+# roughly the v5e multi-host pod-slice spin-up the catalog models.
+SPOT_RECOVERY_SECONDS = 180.0
+
 # Service class fallbacks (reference: pkg/config/defaults.go:24-33).
 DEFAULT_SERVICE_CLASS_NAME = "Free"
 DEFAULT_SERVICE_CLASS_PRIORITY = 100

@@ -18,7 +18,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Mapping
 
-from inferno_tpu.config.defaults import SaturationPolicy
+from inferno_tpu.config.defaults import (
+    SPOT_RECOVERY_SECONDS,
+    SPOT_RISK_PENALTY_FACTOR,
+    SaturationPolicy,
+)
 from inferno_tpu.config.tpu_catalog import SliceShape, slice_shape
 
 
@@ -81,6 +85,12 @@ class AcceleratorSpec:
     # additionally draw from any matching "pool/region" quota bucket
     # (CapacitySpec.quotas) when one is configured
     region: str = ""
+    # whether this shape is offered on its pool's spot tier
+    # (CapacitySpec.spot): False keeps every replica of this shape on
+    # reserved capacity even when the pool has a spot market — the lever
+    # for shapes the provider never sells preemptible (e.g. large
+    # multi-host reservations)
+    spot_eligible: bool = True
     mem_per_chip_gb: float = 16.0  # HBM per chip
     mem_bw_gbs: float = 820.0  # HBM bandwidth per chip
     cost_per_chip_hr: float = 0.0  # cents per chip-hour
@@ -107,7 +117,7 @@ class AcceleratorSpec:
         return self.mem_per_chip_gb * self.chips
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "name": self.name,
             "pool": self.pool,
             "chips": self.chips,
@@ -117,6 +127,11 @@ class AcceleratorSpec:
             "costPerChipHr": self.cost_per_chip_hr,
             "power": self.power.to_dict(),
         }
+        # emitted only when non-default so pre-spot documents (and their
+        # recorder fingerprints) round-trip byte-identically
+        if not self.spot_eligible:
+            out["spotEligible"] = False
+        return out
 
     @classmethod
     def from_dict(cls, d: Mapping[str, Any]) -> "AcceleratorSpec":
@@ -125,6 +140,7 @@ class AcceleratorSpec:
             pool=_get(d, "pool", "type", default=""),
             chips=int(_get(d, "chips", "multiplicity", default=0) or 0),
             region=str(d.get("region", "") or ""),
+            spot_eligible=bool(d.get("spotEligible", True)),
             mem_per_chip_gb=float(_get(d, "memPerChipGB", "memSize", default=16.0)),
             mem_bw_gbs=float(_get(d, "memBWGBs", "memBW", default=820.0)),
             cost_per_chip_hr=float(_get(d, "costPerChipHr", "cost", default=0.0)),
@@ -436,10 +452,13 @@ class AllocationData:
     cost: float = 0.0  # cents/hr
     itl_average: float = 0.0  # msec
     ttft_average: float = 0.0  # msec
+    # replicas of this allocation placed on the pool's spot tier
+    # (0 <= spot_replicas <= num_replicas; always 0 without a tier)
+    spot_replicas: int = 0
     load: ServerLoadSpec = dataclasses.field(default_factory=ServerLoadSpec)
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "accelerator": self.accelerator,
             "numReplicas": self.num_replicas,
             "maxBatch": self.max_batch,
@@ -448,6 +467,12 @@ class AllocationData:
             "ttftAverage": self.ttft_average,
             "load": self.load.to_dict(),
         }
+        # emitted only when spot placed, so pre-spot documents (and the
+        # flight recorder's canonicalized snapshot fingerprints) are
+        # byte-identical with the tier disabled
+        if self.spot_replicas:
+            out["spotReplicas"] = self.spot_replicas
+        return out
 
     @classmethod
     def from_dict(cls, d: Mapping[str, Any]) -> "AllocationData":
@@ -458,6 +483,7 @@ class AllocationData:
             cost=float(d.get("cost", 0.0) or 0.0),
             itl_average=float(d.get("itlAverage", 0.0) or 0.0),
             ttft_average=float(d.get("ttftAverage", 0.0) or 0.0),
+            spot_replicas=int(d.get("spotReplicas", 0) or 0),
             load=ServerLoadSpec.from_dict(d.get("load", {}) or {}),
         )
 
@@ -526,6 +552,79 @@ class OptimizerSpec:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class SpotPoolSpec:
+    """One pool's preemptible (spot) tier: cheaper chips that can vanish
+    in correlated eviction storms (ConfigMap/env key `TPU_SPOT_POOLS`,
+    parsed with actionable validation by `spot.market.parse_spot_pools`).
+
+    The risk model (`inferno_tpu/spot/market.py`) prices the tier:
+    replicas placed on spot cost `(1 - discount)` of the reserved price;
+    a storm arrives at `hazard_per_hr` and reclaims `blast_radius` of
+    the pool's spot replicas at once, each taking `recovery_s` to
+    re-provision. Spot replicas whose eviction would breach the SLO
+    carry a risk premium in the solver objective, and the limited-mode
+    solve pre-positions `ceil(blast_radius x spot chips)` of reserved
+    headroom to absorb the implied blast radius.
+    """
+
+    discount: float  # fraction off the reserved price, (0, 1)
+    hazard_per_hr: float = 0.0  # correlated eviction storms per hour
+    blast_radius: float = 0.5  # fraction of spot replicas per storm, (0, 1]
+    recovery_s: float = SPOT_RECOVERY_SECONDS  # eviction -> serving again
+    chips: int = 0  # spot-tier chip budget; 0 = elastic (unbounded)
+    penalty_factor: float = SPOT_RISK_PENALTY_FACTOR  # SLO-violation pricing
+
+    def validate(self) -> None:
+        if not 0.0 < self.discount < 1.0:
+            raise ValueError(f"discount must be in (0, 1), got {self.discount}")
+        if self.hazard_per_hr < 0.0:
+            raise ValueError(
+                f"hazardPerHr must be >= 0, got {self.hazard_per_hr}"
+            )
+        if not 0.0 < self.blast_radius <= 1.0:
+            raise ValueError(
+                f"blastRadius must be in (0, 1], got {self.blast_radius}"
+            )
+        if self.recovery_s <= 0.0:
+            raise ValueError(
+                f"recoverySeconds must be > 0, got {self.recovery_s}"
+            )
+        if self.chips < 0:
+            raise ValueError(f"chips must be >= 0, got {self.chips}")
+        if self.penalty_factor < 0.0:
+            raise ValueError(
+                f"penaltyFactor must be >= 0, got {self.penalty_factor}"
+            )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "discount": self.discount,
+            "hazardPerHr": self.hazard_per_hr,
+            "blastRadius": self.blast_radius,
+            "recoverySeconds": self.recovery_s,
+            "chips": self.chips,
+            "penaltyFactor": self.penalty_factor,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "SpotPoolSpec":
+        # explicit zeros are preserved (so validate() can reject them
+        # with the field's own message); only a MISSING key defaults
+        def _get(key: str, default: float) -> float:
+            v = d.get(key)
+            return default if v is None else float(v)
+
+        return cls(
+            discount=float(d["discount"]),
+            hazard_per_hr=_get("hazardPerHr", 0.0),
+            blast_radius=_get("blastRadius", 0.5),
+            recovery_s=_get("recoverySeconds", SPOT_RECOVERY_SECONDS),
+            chips=int(d.get("chips", 0) or 0),
+            penalty_factor=_get("penaltyFactor", SPOT_RISK_PENALTY_FACTOR),
+        )
+
+
 @dataclasses.dataclass
 class CapacitySpec:
     """Available chips per pool (generation), e.g. {"v5e": 64, "v5p": 32}.
@@ -540,28 +639,42 @@ class CapacitySpec:
     `AcceleratorSpec.region`). An allocation must fit its pool budget AND
     every matching quota bucket; a pool or quota absent from `chips` /
     `quotas` respectively means zero capacity / no extra constraint.
+
+    `spot` attaches a preemptible tier per pool (`SpotPoolSpec`): spot
+    replicas draw the tier's own chip budget instead of the pool budget
+    (quotas constrain reserved commitments only), at a discounted,
+    eviction-risk-adjusted price.
     """
 
     chips: dict[str, int] = dataclasses.field(default_factory=dict)
     quotas: dict[str, int] = dataclasses.field(default_factory=dict)
+    spot: dict[str, SpotPoolSpec] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {"chips": dict(self.chips)}
         if self.quotas:
             out["quotas"] = dict(self.quotas)
+        if self.spot:
+            out["spot"] = {k: v.to_dict() for k, v in self.spot.items()}
         return out
 
     @classmethod
     def from_dict(cls, d: Mapping[str, Any]) -> "CapacitySpec":
         quotas = {k: int(v) for k, v in (d.get("quotas", {}) or {}).items()}
+        spot = {
+            k: SpotPoolSpec.from_dict(v)
+            for k, v in (d.get("spot", {}) or {}).items()
+        }
         if "chips" in d:
             return cls(
-                chips={k: int(v) for k, v in d["chips"].items()}, quotas=quotas
+                chips={k: int(v) for k, v in d["chips"].items()},
+                quotas=quotas, spot=spot,
             )
         # reference shape: {"count": [{"type": ..., "count": ...}]}
         counts = d.get("count", []) or []
         return cls(
-            chips={c["type"]: int(c["count"]) for c in counts}, quotas=quotas
+            chips={c["type"]: int(c["count"]) for c in counts},
+            quotas=quotas, spot=spot,
         )
 
 

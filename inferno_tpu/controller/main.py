@@ -74,6 +74,12 @@ controller.go:516-582):
                                 scoreboard in (0,1] (default 0.2; see
                                 /debug/attainment and the
                                 inferno_model_error_* gauges)
+  TPU_SPOT_POOLS                fallback for the ConfigMap key of the same
+                                name: per-pool preemptible (spot) tiers —
+                                discount, eviction hazard, blast radius —
+                                for clusterless runs (docs/user-guide/
+                                configuration.md; validated at parse time
+                                by inferno_tpu/spot/market.py)
 """
 
 from __future__ import annotations

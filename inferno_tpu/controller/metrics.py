@@ -533,6 +533,63 @@ class AttainmentInstruments:
                     series.remove(labels)
 
 
+# Spot-market placement / preemption series (inferno_tpu/spot/). All
+# carry the inferno_ prefix AND a unit suffix per obs/lint.py.
+METRIC_SPOT_REPLICAS = "inferno_spot_replicas"
+METRIC_RESERVED_HEADROOM = "inferno_reserved_headroom_chips"
+METRIC_PREEMPTIONS = "inferno_preemptions_total"
+LABEL_POOL = "pool"
+
+
+class SpotInstruments:
+    """Per-pool spot-market series: replicas the last solve placed on
+    the preemptible tier, the reserved-headroom chips the pre-positioner
+    holds free for the configured blast radius, and a counter of
+    detected preemptions (a cycle observing a spot-placed variant's
+    replicas below the previous desired count). Registered
+    unconditionally, like the forecast gauges, so the metric catalog
+    (and `make lint-metrics`) is independent of whether TPU_SPOT_POOLS
+    is set; pools that stop placing spot zero their gauges rather than
+    freeze them."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self.spot_replicas = self.registry.gauge(
+            METRIC_SPOT_REPLICAS,
+            "Replicas placed on the pool's preemptible (spot) tier by the "
+            "last solve",
+        )
+        self.headroom = self.registry.gauge(
+            METRIC_RESERVED_HEADROOM,
+            "Reserved chips the pre-positioner holds free to absorb the "
+            "pool's configured spot blast radius",
+        )
+        self.preemptions = self.registry.counter(
+            METRIC_PREEMPTIONS,
+            "Detected spot preemptions: cycles observing a spot-placed "
+            "variant's replicas below the previously desired count",
+        )
+
+    def set_pool(self, pool: str, spot_replicas: int,
+                 headroom_chips: int) -> None:
+        labels = {LABEL_POOL: pool}
+        self.spot_replicas.set(labels, float(spot_replicas))
+        self.headroom.set(labels, float(headroom_chips))
+
+    def zero_missing_pools(self, live: set[str]) -> None:
+        """Pools with a gauge series but no spot placement this cycle
+        read 0, not their last value — an operator watching a drained
+        pool must see the drain."""
+        for series in (self.spot_replicas, self.headroom):
+            for _, (labels, _v) in list(series.values.items()):
+                if labels.get(LABEL_POOL, "") not in live:
+                    series.set(labels, 0.0)
+
+    def count_preemptions(self, pool: str, n: int) -> None:
+        if n > 0:
+            self.preemptions.inc({LABEL_POOL: pool}, float(n))
+
+
 class TLSConfig:
     """Serve-side TLS with cert reload (the reference uses certwatchers on
     its metrics endpoint, cmd/main.go:122-199). Certs are re-read when the

@@ -19,6 +19,7 @@ import concurrent.futures
 import dataclasses
 import json
 import logging
+import os
 import threading
 import time
 from typing import Any, Callable
@@ -75,6 +76,7 @@ from inferno_tpu.obs import (
     REASON_ERROR,
     REASON_FORECAST_BOUND,
     REASON_SLO_BOUND,
+    REASON_SPOT_RISK_BOUND,
     REASON_STABILIZATION_HOLD,
     SIZING_PROVENANCE_CACHED,
     DecisionRecord,
@@ -366,6 +368,7 @@ class Reconciler:
             CycleInstruments,
             ForecastInstruments,
             MetricsEmitter,
+            SpotInstruments,
         )
 
         from inferno_tpu.controller.logger import get_logger
@@ -447,6 +450,14 @@ class Reconciler:
             AttainmentConfig(ewma_gain=self.config.attainment_ewma_gain)
         )
         self.attainment_instruments = AttainmentInstruments(self.emitter.registry)
+        # spot-market placement gauges + preemption counter (spot/,
+        # TPU_SPOT_POOLS): registered unconditionally (lint parity);
+        # populated only when a solve places spot. _prev_spot remembers
+        # last cycle's desired (replicas, spot, pool) per variant so a
+        # later cycle observing fewer live replicas on a spot-placed
+        # variant counts a detected preemption.
+        self.spot_instruments = SpotInstruments(self.emitter.registry)
+        self._prev_spot: dict[str, tuple[int, int, str]] = {}
         # flight recorder (obs/recorder.py, env FLIGHT_RECORDER_DIR,
         # default off): per-cycle fleet snapshot + decisions, enqueued in
         # _finish_cycle and written off the hot path
@@ -547,6 +558,9 @@ class Reconciler:
                     # placement region: selects the "pool/region" quota
                     # bucket (TPU_POOL_QUOTAS) this shape draws from
                     region=str(obj.get("region", "") or ""),
+                    # '"spot": false' keeps this shape off its pool's
+                    # preemptible tier (TPU_SPOT_POOLS) entirely
+                    spot_eligible=bool(obj.get("spot", True)),
                 )
             )
         return out
@@ -599,16 +613,30 @@ class Reconciler:
             except (json.JSONDecodeError, ValueError, AttributeError):
                 pass
         # per-pool[/region] quota carve-outs layered on the pool budgets
-        # ({"v5e": 256, "v5e/us-east1": 64}); malformed JSON is ignored
-        # like TPU_CAPACITY — a ConfigMap typo must not abort the cycle
-        raw_quotas = data.get("TPU_POOL_QUOTAS", "")
-        if raw_quotas:
-            try:
-                capacity.quotas = {
-                    k: int(v) for k, v in json.loads(raw_quotas).items()
-                }
-            except (json.JSONDecodeError, ValueError, AttributeError):
-                pass
+        # ({"v5e": 256, "v5e/us-east1": 64}). Validated at parse time
+        # (spot/market.py): a malformed entry logs ONE actionable error
+        # naming the offending key and the expected format, and the
+        # whole key is ignored this cycle — a ConfigMap typo must
+        # surface loudly but never abort the cycle
+        from inferno_tpu.spot.market import (
+            SpotConfigError,
+            parse_pool_quotas,
+            parse_spot_pools,
+        )
+
+        try:
+            capacity.quotas = parse_pool_quotas(data.get("TPU_POOL_QUOTAS", ""))
+        except SpotConfigError as e:
+            self.log.error("ignoring TPU_POOL_QUOTAS this cycle: %s", e)
+        # the spot tier per pool: ConfigMap key first, env var fallback
+        # (emulator/bench runs configure spot without a cluster)
+        raw_spot = data.get("TPU_SPOT_POOLS", "") or os.environ.get(
+            "TPU_SPOT_POOLS", ""
+        )
+        try:
+            capacity.spot = parse_spot_pools(raw_spot)
+        except SpotConfigError as e:
+            self.log.error("ignoring TPU_SPOT_POOLS this cycle: %s", e)
         if not optimizer.unlimited and not capacity.chips:
             # limited mode with no static capacity: discover chip pools from
             # node google.com/tpu resources (inventory.py); an inventory
@@ -617,8 +645,11 @@ class Reconciler:
             # be visible in the logs. Configured quotas survive discovery
             # (they carve the discovered budgets, not replace them).
             try:
+                # quotas AND spot tiers survive discovery: both carve or
+                # price the discovered budgets, they don't replace them
                 capacity = dataclasses.replace(
-                    collect_tpu_inventory(self.kube), quotas=capacity.quotas
+                    collect_tpu_inventory(self.kube),
+                    quotas=capacity.quotas, spot=capacity.spot,
                 )
             except (KubeError, OSError):
                 # OSError: connection-level failures (URLError) bypass the
@@ -890,6 +921,26 @@ class Reconciler:
         asleep = c.asleep
         class_name, target = c.class_name, c.target
         matching_profiles = c.matching_profiles
+
+        # detected spot preemption: replicas DROPPED below what was both
+        # running and desired last cycle, on a spot-placed variant —
+        # count up to the spot count as evicted. The baseline is
+        # min(observed, desired): still-spinning-up capacity never
+        # "drops" (scale-up lag is not an eviction), and an intentional
+        # scale-down lowered the desired side first.
+        prev = self._prev_spot.get(va.full_name)
+        if prev is not None:
+            baseline, prev_spot, prev_pool = prev
+            lost = baseline - current.num_replicas
+            if prev_spot > 0 and lost > 0:
+                counted = min(lost, prev_spot)
+                self.spot_instruments.count_preemptions(prev_pool, counted)
+                # lower the stored baseline to what was counted against:
+                # if this cycle fails before _publish_spot refreshes it,
+                # the next cycle must not re-count the same eviction
+                self._prev_spot[va.full_name] = (
+                    current.num_replicas, prev_spot - counted, prev_pool,
+                )
 
         # Perf data registers under a per-variant model key: the registry is
         # keyed (model, acc) with last-wins semantics, so two variants
@@ -1298,10 +1349,49 @@ class Reconciler:
                 sizing_ms=round(report.analysis_ms, 3),
                 solver_ms=round(report.solver_ms, 3),
             )
+            self._publish_spot(system)
 
         with tracer.span("actuate") as sp:
             self._apply(prepared, solution, report, system)
             sp.set(variants_applied=report.variants_applied)
+
+    def _publish_spot(self, system: System) -> None:
+        """Per-pool spot gauges from the solved placement, and the
+        next-cycle preemption-detection baseline. Pools that stopped
+        placing spot read 0 (an operator must see the drain); with no
+        tier configured anywhere this is a no-op beyond zeroing."""
+        if not getattr(system, "spot", None):
+            if self._prev_spot:
+                self._prev_spot = {}
+                self.spot_instruments.zero_missing_pools(set())
+            return
+        from inferno_tpu.spot.market import headroom_chips
+
+        usage = system.allocate_by_pool()
+        live: set[str] = set()
+        for pool, spec in system.spot.items():
+            u = usage.get(pool)
+            spot_replicas = u.spot_replicas if u else 0
+            spot_chips = u.spot_chips if u else 0
+            self.spot_instruments.set_pool(
+                pool, spot_replicas,
+                headroom_chips(spec.blast_radius, spot_chips),
+            )
+            live.add(pool)
+        self.spot_instruments.zero_missing_pools(live)
+        self._prev_spot = {}
+        for name, server in system.servers.items():
+            alloc = server.allocation
+            if alloc is None or not alloc.accelerator:
+                continue
+            acc = system.accelerators.get(alloc.accelerator)
+            self._prev_spot[name] = (
+                # eviction-detection baseline: what was BOTH running and
+                # desired (see _assemble_variant's detector)
+                min(alloc.num_replicas, server.cur_allocation.num_replicas),
+                alloc.spot_replicas,
+                acc.pool if acc is not None else "",
+            )
 
     # -- sizing cache (controller/sizing_cache.py) ---------------------------
 
@@ -1673,6 +1763,7 @@ class Reconciler:
             if system is not None
             else None
         )
+        rec.spot_replicas = alloc.spot_replicas
         if degr is not None:
             rec.degradation_step = degr.step
             rec.chip_shortfall = degr.shortfall_chips
@@ -1714,6 +1805,14 @@ class Reconciler:
                 "replicas sized by the forecast upper band at the spin-up "
                 f"horizon ({rec.forecast_upper_rpm:.1f} rpm over observed "
                 f"{rec.arrival_rpm:.1f} rpm)"
+            )
+        elif chosen is not None and chosen.spot_trimmed:
+            reason = REASON_SPOT_RISK_BOUND
+            detail = (
+                "spot placement capped by eviction risk: "
+                f"{alloc.spot_replicas}/{alloc.num_replicas} replicas on the "
+                "spot tier (the hazard-implied premium outweighs the "
+                "discount for SLO-critical replicas)"
             )
         elif alloc.num_replicas > min_replicas:
             reason = REASON_SLO_BOUND

@@ -59,11 +59,15 @@ def system_fingerprint(system) -> tuple:
     replay sizings whose solve context changed."""
     return (
         tuple(
-            (a.name, a.pool, a.chips, a.cost, a.region)
+            (a.name, a.pool, a.chips, a.cost, a.region, a.spec.spot_eligible)
             for a in sorted(system.accelerators.values(), key=lambda a: a.name)
         ),
         tuple(sorted(system.capacity.items())),
         tuple(sorted(getattr(system, "quotas", {}).items())),
+        # the spot tier changes candidate COSTS (discount, premium,
+        # split), not just the solve context — a TPU_SPOT_POOLS edit
+        # must re-derive every cached sizing
+        tuple(sorted(getattr(system, "spot", {}).items())),
     )
 
 
@@ -169,7 +173,14 @@ class SizingCache:
         out: dict[str, Allocation] = {}
         for acc, alloc in entry.allocations.items():
             replay = alloc.clone()
-            replay.value = transition_penalty(cur_allocation, replay)
+            # the same objective every fresh sizing path computes:
+            # transition penalty PLUS the spot-tier risk premium (zero
+            # without a tier) — a cached cycle must not solve a
+            # different objective than the solved cycle it replays
+            replay.value = (
+                transition_penalty(cur_allocation, replay)
+                + replay.spot_premium
+            )
             out[acc] = replay
         return out
 

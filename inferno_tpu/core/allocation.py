@@ -40,12 +40,23 @@ class Allocation:
     accelerator: str  # slice shape name; "" = no allocation
     num_replicas: int  # pod-slices
     batch_size: int
-    cost: float  # cents/hr
+    cost: float  # cents/hr (spot discount already applied)
     value: float = 0.0  # solver objective (cost or transition penalty)
     itl: float = 0.0  # expected avg token decode time, msec
     ttft: float = 0.0  # expected avg queueing + prefill time, msec
     rho: float = 0.0  # expected utilization
     max_arrv_rate_per_replica: float = 0.0  # req/msec
+    # -- spot tier (inferno_tpu/spot/market.py; all zero when the pool
+    # has no spot tier, keeping pre-spot behavior bit-identical) --------
+    spot_replicas: int = 0  # replicas placed on the preemptible tier
+    spot_discount: float = 0.0  # cents/hr taken off the reserved price
+    # risk premium (cents/hr) the solver objective carries for risky
+    # spot replicas — added to `value` on top of the transition penalty,
+    # never to the reported cost
+    spot_premium: float = 0.0
+    # risk (not price) capped spot below the full replica count: the
+    # `spot_risk_bound` decision-reason signal
+    spot_trimmed: bool = False
 
     @property
     def max_rpm(self) -> float:
@@ -69,6 +80,7 @@ class Allocation:
             cost=self.cost,
             itl_average=self.itl,
             ttft_average=self.ttft,
+            spot_replicas=self.spot_replicas,
         )
 
 
@@ -81,6 +93,7 @@ def allocation_from_data(data: AllocationData) -> Allocation:
         cost=data.cost,
         itl=data.itl_average,
         ttft=data.ttft_average,
+        spot_replicas=data.spot_replicas,
     )
 
 
@@ -109,7 +122,13 @@ def create_allocation(system: "System", server_name: str, acc_name: str) -> Allo
         return None
 
     if load.arrival_rate == 0 or load.avg_out_tokens == 0:
-        return _zero_load_allocation(server, model, acc, perf)
+        alloc = _zero_load_allocation(server, model, acc, perf)
+        # zero-load spot: no load-required replicas, so every held
+        # replica is storm-safe slack — full discount, no premium
+        _apply_spot(
+            system, alloc, acc.cost * model.slices_per_replica(acc_name), 0
+        )
+        return alloc
 
     # max batch size scaled by the average output length K relative to the
     # token count the profile's max batch was measured at
@@ -182,7 +201,25 @@ def create_allocation(system: "System", server_name: str, acc_name: str) -> Allo
         max_arrv_rate_per_replica=rate_star / 1000.0,
     )
     alloc.value = alloc.cost
+    # spot tier (inferno_tpu/spot/market.py): replicas above the
+    # load-required count are storm-safe slack; the rest ride spot only
+    # when the risk premium beats the discount. No-op without a tier.
+    _apply_spot(
+        system, alloc,
+        acc.cost * model.slices_per_replica(acc_name),
+        math.ceil(total_rate / rate_star),
+    )
     return alloc
+
+
+def _apply_spot(system, alloc, cost_per_replica, required) -> None:
+    """Local-import shim for spot.market.apply_spot (the spot package
+    imports config only; this keeps core <-> spot acyclic)."""
+    if not getattr(system, "spot", None):
+        return
+    from inferno_tpu.spot.market import apply_spot
+
+    apply_spot(system, alloc, cost_per_replica, required)
 
 
 def _zero_load_allocation(server, model, acc, perf) -> Allocation:

@@ -168,12 +168,18 @@ class Server:
     def calculate(self, system: "System") -> None:
         """Build candidate allocations on every feasible slice shape; the
         solver objective ("value") is the transition penalty from the
-        current allocation (reference: pkg/core/server.go:55-67)."""
+        current allocation (reference: pkg/core/server.go:55-67), plus
+        the spot-tier risk premium when the candidate places risky
+        replicas on preemptible capacity (spot/market.py; zero without a
+        tier, keeping the pre-spot objective bit-identical)."""
         self.all_allocations = {}
         for g in self.candidate_accelerators(system).values():
             alloc = create_allocation(system, self.name, g.name)
             if alloc is not None:
-                alloc.value = transition_penalty(self.cur_allocation, alloc)
+                alloc.value = (
+                    transition_penalty(self.cur_allocation, alloc)
+                    + alloc.spot_premium
+                )
                 self.all_allocations[g.name] = alloc
 
     def set_allocation(self, alloc: Allocation | None) -> None:
@@ -216,6 +222,11 @@ class PoolUsage:
     chips: int = 0
     cost: float = 0.0
     watts: float = 0.0
+    # chips of the total placed on the pool's preemptible (spot) tier,
+    # and the replicas they carry — the reconciler's spot gauges and the
+    # reserved-headroom arithmetic read these per cycle
+    spot_chips: int = 0
+    spot_replicas: int = 0
 
 
 class System:
@@ -232,6 +243,11 @@ class System:
         # or "pool/region" (per-region carve-out) -> chips. An allocation
         # must fit its pool budget AND every matching quota bucket.
         self.quotas: dict[str, int] = {}
+        # preemptible tier per pool (config.types.SpotPoolSpec, ConfigMap/
+        # env TPU_SPOT_POOLS): spot replicas draw the tier's own budget
+        # at a discounted, eviction-risk-adjusted price (spot/market.py).
+        # Empty = no spot anywhere, and every spot branch is skipped.
+        self.spot: dict = {}
         self.pool_usage: dict[str, PoolUsage] = {}
         # set by calculate_all / parallel.calculate_fleet; lets the
         # optimizer's auto mode distinguish "never sized" from "sized and
@@ -260,6 +276,7 @@ class System:
             self.servers[server_spec.name] = Server(server_spec)
         self.capacity.update(spec.capacity.chips)
         self.quotas.update(spec.capacity.quotas)
+        self.spot.update(spec.capacity.spot)
 
     # -- solve support ------------------------------------------------------
 
@@ -294,6 +311,12 @@ class System:
             u.chips += slices * acc.chips
             u.cost += alloc.cost
             u.watts += slices * acc.power(alloc.rho)
+            if alloc.spot_replicas:
+                u.spot_chips += (
+                    alloc.spot_replicas * model.slices_per_replica(acc.name)
+                    * acc.chips
+                )
+                u.spot_replicas += alloc.spot_replicas
         self.pool_usage = usage
         return usage
 

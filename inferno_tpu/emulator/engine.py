@@ -130,6 +130,10 @@ class EmulatedEngine:
         self.emu_ms = 0.0  # virtual clock: emulated msec since start
         self._last_tick_wall = time.time()  # wall time of the last clock advance
         self.started_at = time.time()
+        # spot-eviction state (spot/injection.py): a preempted replica is
+        # gone — loop stopped, in-flight work failed, submissions refused
+        self.preempted = False
+        self.preempted_requests = 0
         self.thread = threading.Thread(target=self._loop, daemon=True)
 
     # -- public API ---------------------------------------------------------
@@ -142,6 +146,31 @@ class EmulatedEngine:
         self.stop_flag = True
         self.thread.join(timeout=5)
 
+    def preempt(self) -> int:
+        """Kill this replica mid-run, as a spot eviction does: the decode
+        loop stops, every waiting or running request fails permanently
+        (their `wait_for_result` returns ``(None, True)`` — the caller
+        must resubmit on a surviving replica), and later submissions are
+        refused. Returns the number of in-flight requests killed.
+
+        Unlike `stop()` this is abrupt BY DESIGN: no drain, no
+        completion stamps — a reclaimed TPU slice does not say goodbye.
+        """
+        self.stop_flag = True
+        with self.lock:
+            self.preempted = True
+            victims = list(self.waiting) + list(self.running.values())
+            self.waiting.clear()
+            self.running.clear()
+            self._new.clear()
+            self._finish_heap.clear()
+            self._kv_reserved = 0
+            self.preempted_requests += len(victims)
+        for r in victims:
+            r.rejected = True
+            r.done_event.set()
+        return len(victims)
+
     def submit(self, in_tokens: int, out_tokens: int) -> _Request:
         req = _Request(in_tokens=in_tokens, out_tokens=max(out_tokens, 1), arrived=time.time())
         if req.in_tokens + req.out_tokens > self.profile.kv_tokens_capacity:
@@ -150,6 +179,16 @@ class EmulatedEngine:
             req.done_event.set()
             return req
         with self.lock:
+            if self.preempted:
+                # a dead replica serves nothing: the caller (load
+                # balancer) must route elsewhere — same (None, True)
+                # contract as an over-length rejection. Checked UNDER
+                # the lock preempt() holds while clearing the queues: a
+                # check-then-append race would strand the request with
+                # the decode loop already gone.
+                req.rejected = True
+                req.done_event.set()
+                return req
             elapsed = time.time() - self._last_tick_wall
             req.arrived_emu = self.emu_ms + elapsed * 1000.0 / max(self.time_scale, 1e-9)
             self.waiting.append(req)

@@ -57,6 +57,12 @@ class Scenario:
     # (wall-paced schedules drift with host overhead — VERDICT r5 §5).
     # Aggregated single-replica scenarios only (the clock is engines[0]).
     emu_paced: bool = False
+    # spot-eviction injection (spot/injection.PreemptionInjector):
+    # (emulated seconds, replicas to kill) — at each scheduled virtual
+    # time the injector preempts that many surviving replicas, failing
+    # their in-flight requests. Injection polls wall-derived virtual
+    # clocks, so tests driving it belong in the slow tier.
+    preempt_at: tuple[tuple[float, int], ...] = ()
 
 
 @dataclasses.dataclass
@@ -72,6 +78,7 @@ class RunStats:
     queue_depth: list[int] = dataclasses.field(default_factory=list)
     emu_window_ms: float = 0.0  # sum over engines of emulated msec of load
     submitted: int = 0
+    preempted_requests: int = 0  # in-flight work killed by eviction injection
 
 
 def rate_trace(
@@ -234,9 +241,18 @@ def run_scenario(scenario: Scenario) -> dict[str, Any]:
 
             sampler = threading.Thread(target=sample, daemon=True)
             sampler.start()
+            injector = None
+            if scenario.preempt_at:
+                from inferno_tpu.spot.injection import PreemptionInjector
+
+                injector = PreemptionInjector(engines, scenario.preempt_at)
+                injector.start()
             with tracer.span("drive"):
                 gen.start()
                 gen.join()
+            if injector is not None:
+                injector.stop()
+                stats.preempted_requests = injector.preempted_requests
             # emulated length of the arrival window, before drain idles the
             # clocks further: the measured operating point for the model
             # check. Emu-paced runs read the generator's own schedule clock
@@ -291,6 +307,7 @@ def run_scenario(scenario: Scenario) -> dict[str, Any]:
         "runs": scenario.runs,
         "replicas": scenario.replicas,
         "requests": requests,
+        "preempted_requests": sum(s.preempted_requests for s in per_run),
         "offered_rps": offered_rps,
         "ttft_ms": _summary(ttft),
         "latency_ms": _summary(latency),

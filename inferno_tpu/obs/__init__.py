@@ -26,6 +26,7 @@ from inferno_tpu.obs.decision import (
     REASON_ERROR,
     REASON_FORECAST_BOUND,
     REASON_SLO_BOUND,
+    REASON_SPOT_RISK_BOUND,
     REASON_STABILIZATION_HOLD,
     SIZING_PROVENANCE_CACHED,
     SIZING_PROVENANCE_SOLVED,
@@ -52,6 +53,7 @@ __all__ = [
     "REASON_ERROR",
     "REASON_FORECAST_BOUND",
     "REASON_SLO_BOUND",
+    "REASON_SPOT_RISK_BOUND",
     "REASON_STABILIZATION_HOLD",
     "Span",
     "TraceBuffer",

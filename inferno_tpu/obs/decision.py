@@ -27,6 +27,10 @@ REASON_ERROR = "error"  # preparation or optimization failed this cycle
 # predictive scaling (inferno_tpu/forecast/):
 REASON_FORECAST_BOUND = "forecast_bound"  # forecast upper band, not observed λ, set N
 REASON_STABILIZATION_HOLD = "stabilization_hold"  # scale-down gated by the window
+# spot-market economics (inferno_tpu/spot/): eviction risk — not price —
+# capped the variant's spot placement below its full replica count (the
+# hazard-implied premium outweighed the discount for SLO-critical replicas)
+REASON_SPOT_RISK_BOUND = "spot_risk_bound"
 
 REASON_CODES = (
     REASON_SLO_BOUND,
@@ -36,6 +40,7 @@ REASON_CODES = (
     REASON_ERROR,
     REASON_FORECAST_BOUND,
     REASON_STABILIZATION_HOLD,
+    REASON_SPOT_RISK_BOUND,
 )
 
 # Profile-parameter provenance values
@@ -116,6 +121,10 @@ class DecisionRecord:
     chip_shortfall: int = 0
     accelerator: str = ""
     replicas: int = 0
+    # replicas of the decision placed on the pool's preemptible (spot)
+    # tier (spot/market.py) — recorded per cycle so a flight-recorder
+    # replay reproduces the spot placement bit-faithfully
+    spot_replicas: int = 0
     prev_accelerator: str = ""
     prev_replicas: int = 0
     # per-replica sustainable arrival-rate ceiling λ_max at the chosen

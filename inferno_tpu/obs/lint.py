@@ -16,13 +16,18 @@ METRIC_NAME_PREFIX = "inferno_"
 
 # Unit-suffix convention: every series name ends in the unit it is
 # measured in. `_total` marks counters (unitless cumulative counts),
-# `_ratio` dimensionless gauges, the rest physical units.
-UNIT_SUFFIXES = ("_seconds", "_ms", "_total", "_ratio", "_rpm")
+# `_ratio` dimensionless gauges, the rest physical units (`_chips` and
+# `_replicas` are the capacity units of the spot/fleet gauges, ISSUE-11).
+UNIT_SUFFIXES = ("_seconds", "_ms", "_total", "_ratio", "_rpm", "_chips",
+                 "_replicas")
 
 # Grandfathered pre-convention names: these shipped before the suffix
 # rule and are part of the external actuation/dashboard contract, so
 # renaming them would break HPA/KEDA queries. New series must NOT be
-# added here without a contract-level reason.
+# added here without a contract-level reason. (The two *_replicas
+# entries predate `_replicas` joining UNIT_SUFFIXES and are now
+# redundant; they stay pinned because the membership is an external
+# contract, not a style list.)
 UNIT_SUFFIX_ALLOWLIST = frozenset({
     "inferno_desired_replicas",  # HPA/KEDA actuation contract
     "inferno_current_replicas",  # HPA/KEDA actuation contract
@@ -57,8 +62,9 @@ def build_controller_registry():
     it: the four actuation series (MetricsEmitter), the cycle-latency
     histograms + fleet-cycle instruments + recorder drop counter
     (CycleInstruments), the predictive-scaling forecast gauges
-    (ForecastInstruments), and the SLO-attainment / model-error
-    scoreboard gauges (AttainmentInstruments) — each registered
+    (ForecastInstruments), the SLO-attainment / model-error scoreboard
+    gauges (AttainmentInstruments), and the spot-market placement /
+    preemption series (SpotInstruments) — each registered
     unconditionally, like the Reconciler does, so the catalog is
     identical whatever features are enabled."""
     from inferno_tpu.controller.metrics import (
@@ -67,6 +73,7 @@ def build_controller_registry():
         ForecastInstruments,
         MetricsEmitter,
         Registry,
+        SpotInstruments,
     )
 
     registry = Registry()
@@ -74,6 +81,7 @@ def build_controller_registry():
     CycleInstruments(registry)
     ForecastInstruments(registry)
     AttainmentInstruments(registry)
+    SpotInstruments(registry)
     return registry
 
 

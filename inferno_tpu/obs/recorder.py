@@ -93,6 +93,12 @@ _STR_FIELDS = (
     "profile_provenance", "rate_provenance", "sizing_provenance",
 )
 COLUMN_FIELDS = _F64_FIELDS + _F32_FIELDS + _I32_FIELDS + _STR_FIELDS
+# Columns added AFTER schema v1 shipped: always written, but OPTIONAL on
+# read — a block recorded by an older controller simply lacks them and
+# the reader fills zeros, so adding one never invalidates an archive.
+# (A column a reader must not default belongs in COLUMN_FIELDS plus a
+# SCHEMA_VERSION bump instead.)
+OPTIONAL_I32_FIELDS = ("spot_replicas",)  # spot-tier placement (ISSUE-11)
 
 
 def spec_fingerprint(spec_doc: dict) -> str:
@@ -421,7 +427,7 @@ class FlightRecorder:
         for field, dtype, fields in (
             ("f8", np.float64, _F64_FIELDS),
             ("f4", np.float32, _F32_FIELDS),
-            ("i4", np.int32, _I32_FIELDS),
+            ("i4", np.int32, _I32_FIELDS + OPTIONAL_I32_FIELDS),
         ):
             del field
             for name in fields:
@@ -658,6 +664,14 @@ def read_artifact(
                         f"{seg_name}: cycle references bad block row; skipped"
                     )
                     continue
+                columns = {f: block[f][row] for f in COLUMN_FIELDS}
+                for f in OPTIONAL_I32_FIELDS:
+                    # pre-spot artifacts lack the column; zeros = the
+                    # value every decision of that era actually had
+                    columns[f] = (
+                        block[f][row] if f in block
+                        else np.zeros(len(variants), np.int32)
+                    )
                 cycles.append(RecordedCycle(
                     seq=int(doc.get("seq", 0) or 0),
                     ts=float(doc.get("ts", 0.0) or 0.0),
@@ -669,7 +683,7 @@ def read_artifact(
                     errors=int(doc.get("errors", 0) or 0),
                     fingerprint=str(doc.get("fingerprint", "") or ""),
                     variants=[str(v) for v in variants],
-                    columns={f: block[f][row] for f in COLUMN_FIELDS},
+                    columns=columns,
                 ))
     for w in warnings:
         (warn or log.warning)(w)

@@ -24,6 +24,7 @@ import numpy as np
 
 from inferno_tpu.core.allocation import (
     Allocation,
+    _apply_spot,
     _zero_load_allocation,
     transition_penalty,
 )
@@ -615,36 +616,51 @@ class _LaneSource:
     lazy-materialization counter the capacity-solver tests assert on (a
     constrained solve must stay O(servers), never inflate O(lanes))."""
 
-    __slots__ = ("plans", "results", "values", "batches", "materialized")
+    __slots__ = ("plans", "results", "values", "batches", "spot", "materialized")
 
     def __init__(self):
         self.plans: dict[str, object] = {}
         self.results: dict[str, object] = {}
         self.values: dict[str, np.ndarray] = {}
         self.batches: dict[str, np.ndarray] = {}
+        # per-kind spot columns when the System carries a spot tier:
+        # (cost_adj f64, spot_reps i64, discount f64, premium f64,
+        # trimmed bool); None keeps the pre-spot materialization (and
+        # its f32 cost conversion) bit-identical
+        self.spot: dict[str, tuple | None] = {}
         self.materialized = 0
 
-    def add(self, kind, plan, result, values, batches) -> None:
+    def add(self, kind, plan, result, values, batches, spot=None) -> None:
         self.plans[kind] = plan
         self.results[kind] = result
         self.values[kind] = values
         self.batches[kind] = batches
+        self.spot[kind] = spot
 
     def materialize(self, kind: str, lane: int) -> Allocation:
         self.materialized += 1
         res = self.results[kind]
         _, acc = self.plans[kind].lanes[lane]
+        spot = self.spot.get(kind)
         alloc = Allocation(
             accelerator=acc,
             num_replicas=int(res.num_replicas[lane]),
             batch_size=int(self.batches[kind][lane]),
-            cost=float(res.cost[lane]),
+            cost=(
+                float(res.cost[lane]) if spot is None
+                else float(spot[0][lane])
+            ),
             itl=float(res.itl[lane]),
             ttft=float(res.ttft[lane]),
             rho=float(res.rho[lane]),
             max_arrv_rate_per_replica=float(res.rate_star[lane]) / 1000.0,
         )
         alloc.value = float(self.values[kind][lane])
+        if spot is not None:
+            alloc.spot_replicas = int(spot[1][lane])
+            alloc.spot_discount = float(spot[2][lane])
+            alloc.spot_premium = float(spot[3][lane])
+            alloc.spot_trimmed = bool(spot[4][lane])
         return alloc
 
 
@@ -755,10 +771,11 @@ class FleetCandidates:
     kind: np.ndarray  # 0=agg, 1=tan per sorted row
     lane: np.ndarray  # lane index into that kind's plan
     value: np.ndarray  # f64 transition penalty (the solver objective)
-    cost: np.ndarray  # f64
+    cost: np.ndarray  # f64 (spot discount already applied)
     reps: np.ndarray  # int64 SLO-satisfying replica count
     chips: np.ndarray  # int64 chips per replica (slices x slice.chips)
     rank: np.ndarray  # int64 accelerator rank in the sorted catalog
+    spot_reps: np.ndarray  # int64 replicas of `reps` on the spot tier
     bounds: np.ndarray  # per-server segment boundaries into the rows
     seg_server: np.ndarray  # server position per segment
 
@@ -823,7 +840,17 @@ def calculate_fleet(
             if perf is None:
                 continue
             alloc = _zero_load_allocation(server, model, acc, perf)
-            alloc.value = transition_penalty(server.cur_allocation, alloc)
+            # scalar order: spot discount first, then the transition
+            # penalty on the discounted price, plus the risk premium
+            # (zero here — every zero-load replica is storm-safe slack)
+            _apply_spot(
+                system, alloc,
+                acc.cost * model.slices_per_replica(acc.name), 0,
+            )
+            alloc.value = (
+                transition_penalty(server.cur_allocation, alloc)
+                + alloc.spot_premium
+            )
             server.all_allocations[acc.name] = alloc
 
     plan = build_fleet(system, only)
@@ -852,9 +879,18 @@ def calculate_fleet(
         cur_cost[i] = cur.cost
         cur_reps[i] = cur.num_replicas
 
+    # spot tier: per-rank economics columns, resolved once per cycle
+    # (spot/market.py); None keeps every lane on the pre-spot path
+    spot_cols = None
+    if getattr(system, "spot", None):
+        from inferno_tpu.spot.market import rank_columns
+
+        spot_cols = rank_columns(system, sorted(system.accelerators))
+
     n = 0
     src = _LaneSource()
-    # (sidx, rank, value, cost, reps, chips, kind, lane) per feasible lane
+    # (sidx, rank, value, cost, reps, chips, spot_k, kind, lane) per
+    # feasible lane
     cat: list[tuple[np.ndarray, ...]] = []
     kinds = []
     if plan is not None and result is not None:
@@ -867,6 +903,32 @@ def calculate_fleet(
         sidx, rank, chips = _lane_orders(system, names, acc_order, p)
         cost64 = np.asarray(res.cost, np.float64)
         reps = np.asarray(res.num_replicas, np.int64)
+        spot = None
+        if spot_cols is not None:
+            from inferno_tpu.spot.market import spot_split
+
+            # load-required replicas (min-replica floor excluded): the
+            # same f32 fold the jitted sizing ran, at min_replicas = 0 —
+            # replicas above this are storm-safe SLO headroom
+            total = offered_load(
+                np.asarray(p.params.total_rate, np.float32),
+                np.asarray(p.params.target_tps, np.float32),
+                np.asarray(p.params.out_tokens, np.float32),
+                np,
+            )
+            required = fold_replicas(
+                total, np.asarray(res.rate_star, np.float32), np.int32(0), np
+            )
+            spot_k, disc, prem, trimmed = spot_split(
+                reps, required,
+                np.asarray(p.params.cost_per_replica, np.float64),
+                spot_cols[0][rank], spot_cols[1][rank],
+                spot_cols[2][rank], spot_cols[3][rank],
+            )
+            # discount lands on the cost BEFORE the transition penalty
+            # (the scalar path's apply_spot -> Server.calculate order)
+            cost64 = cost64 - disc
+            spot = (cost64, spot_k, disc, prem, trimmed)
         same_acc = rank == cur_rank[sidx]
         ccost = cur_cost[sidx]
         # transition_penalty(), elementwise in f64 with the scalar
@@ -881,12 +943,19 @@ def calculate_fleet(
                 ACCEL_PENALTY_FACTOR * (ccost + cost64) + (cost64 - ccost),
             ),
         )
-        src.add(LaneAllocations._KIND[kind_id], p, res, value, batches)
+        if spot is not None:
+            # risky-spot premium rides the objective, not the price
+            value = value + spot[3]
+        src.add(LaneAllocations._KIND[kind_id], p, res, value, batches, spot)
         fe = np.asarray(res.feasible, bool)
         if fe.any():
+            spot_k_fe = (
+                spot[1][fe] if spot is not None
+                else np.zeros(int(fe.sum()), np.int64)
+            )
             cat.append((
                 sidx[fe], rank[fe], value[fe], cost64[fe],
-                reps[fe], np.asarray(chips, np.int64)[fe],
+                reps[fe], np.asarray(chips, np.int64)[fe], spot_k_fe,
                 np.full(int(fe.sum()), kind_id, np.int64), np.flatnonzero(fe),
             ))
     if not cat:
@@ -894,7 +963,7 @@ def calculate_fleet(
 
     (
         sidx_all, rank_all, val_all, cost_all,
-        reps_all, chips_all, kind_all, lane_all,
+        reps_all, chips_all, spot_all, kind_all, lane_all,
     ) = (np.concatenate(parts) for parts in zip(*cat))
     # per-server segment-argmin with the deterministic tie-break
     # (value, cost, accelerator rank) — mirrors solve_unlimited's scalar key
@@ -922,6 +991,7 @@ def calculate_fleet(
         reps=reps_all[order],
         chips=chips_all[order],
         rank=rank_all[order],
+        spot_reps=spot_all[order],
         bounds=bounds,
         seg_server=s_sorted[starts],
     )
@@ -945,8 +1015,15 @@ class FleetBatchResult:
     choice: np.ndarray  # i32[T, S]
     replicas: np.ndarray  # i32[T, S]
     chips: np.ndarray  # i64[T, S]: whole-slice chip demand
-    cost: np.ndarray  # f32[T, S]: cents/hr
+    cost: np.ndarray  # f32[T, S]: cents/hr (spot discount applied)
     value: np.ndarray  # f64[T, S]: winner transition penalty
+    # spot columns, filled only when the System carries a spot tier
+    # (None otherwise — the extra per-chunk fold is gated on the tier):
+    # replicas of the winner on the spot market, and the load-required
+    # replica count (min-replica floor excluded) the storm evaluator
+    # scores violations against (spot/scenarios.py)
+    spot_replicas: np.ndarray | None = None  # i32[T, S]
+    required: np.ndarray | None = None  # i32[T, S]
 
     @property
     def num_steps(self) -> int:
@@ -1039,11 +1116,13 @@ def calculate_fleet_batch(
     # solve_unlimited (value, cost, accelerator) scan. The O(servers x
     # accelerators) scalar walk only runs when some timestep can actually
     # use it — an all-positive trace (the common planner case) skips it.
+    spot_on = bool(getattr(system, "spot", None))
     zero_choice = np.full(n_srv, -1, np.int32)
     zero_reps = np.zeros(n_srv, np.int32)
     zero_chips = np.zeros(n_srv, np.int64)
     zero_cost = np.zeros(n_srv, np.float32)
     zero_value = np.zeros(n_srv, np.float64)
+    zero_spot = np.zeros(n_srv, np.int32)
     has_load = np.zeros(n_srv, bool)
     out_zero = np.zeros(n_srv, bool)
     for i, server in enumerate(servers_list):
@@ -1072,7 +1151,16 @@ def calculate_fleet_batch(
                 if perf is None:
                     continue
                 alloc = _zero_load_allocation(server, model, acc, perf)
-                alloc.value = transition_penalty(server.cur_allocation, alloc)
+                # the live zero shortcut's op order: discount, penalty
+                # on the discounted price, premium (zero at zero load)
+                _apply_spot(
+                    system, alloc,
+                    acc.cost * model.slices_per_replica(acc.name), 0,
+                )
+                alloc.value = (
+                    transition_penalty(server.cur_allocation, alloc)
+                    + alloc.spot_premium
+                )
                 key = (alloc.value, alloc.cost, alloc.accelerator)
                 if best is None or key < best_key:
                     best, best_key = alloc, key
@@ -1084,6 +1172,7 @@ def calculate_fleet_batch(
                 ) * system.accelerators[best.accelerator].chips
                 zero_cost[i] = best.cost
                 zero_value[i] = best.value
+                zero_spot[i] = best.spot_replicas
 
     # lane structure under a positive placeholder rate: every replayed
     # server must contribute its token-eligible lanes regardless of the
@@ -1111,6 +1200,8 @@ def calculate_fleet_batch(
     chips_out = np.zeros((n_steps, n_srv), np.int64)
     cost_out = np.zeros((n_steps, n_srv), np.float32)
     value_out = np.zeros((n_steps, n_srv), np.float64)
+    spot_out = np.zeros((n_steps, n_srv), np.int32) if spot_on else None
+    required_out = np.zeros((n_steps, n_srv), np.int32) if spot_on else None
 
     # feasible-lane columns (feasibility is rate-independent), concatenated
     # across kinds and grouped per server for the segment argmin
@@ -1149,6 +1240,12 @@ def calculate_fleet_batch(
         l_ccost = cur_cost[l_sidx]
         l_creps = cur_reps[l_sidx]
         lane_pos = np.arange(n_lanes, dtype=np.int64)
+        if spot_on:
+            from inferno_tpu.spot.market import rank_columns
+
+            sc = rank_columns(system, acc_names)
+            l_sd, l_sb, l_sp, l_se = (col[l_rank] for col in sc)
+            l_cpr64 = l_cpr.astype(np.float64)
     else:
         n_lanes = 0
 
@@ -1166,6 +1263,18 @@ def calculate_fleet_batch(
             reps = fold_replicas(total, l_rate_star, l_min_reps, np)
             cost32 = reps.astype(np.float32) * l_cpr
             cost64 = cost32.astype(np.float64)
+            if spot_on:
+                from inferno_tpu.spot.market import spot_split
+
+                # the per-cycle writeback's spot pass, over the whole
+                # chunk: required replicas at min_replicas = 0, the
+                # split, discount off the cost BEFORE the penalty
+                required = fold_replicas(total, l_rate_star, np.int32(0), np)
+                spot_k, disc, prem, _ = spot_split(
+                    reps, required, l_cpr64, l_sd, l_sb, l_sp, l_se,
+                )
+                cost64 = cost64 - disc
+                cost32 = cost64.astype(np.float32)
             # transition_penalty(), same f64 op order as the writeback
             value = np.where(
                 l_same & (reps == l_creps),
@@ -1176,6 +1285,8 @@ def calculate_fleet_batch(
                     ACCEL_PENALTY_FACTOR * (l_ccost + cost64) + (cost64 - l_ccost),
                 ),
             )
+            if spot_on:
+                value = value + prem
             # per-server lexicographic argmin on (value, cost, rank) —
             # the (value, cost, accelerator) key of solve_unlimited and
             # the per-cycle lexsort, over the whole chunk at once
@@ -1197,6 +1308,13 @@ def calculate_fleet_batch(
             chips_out[t0:t1, seg_server] = reps_w.astype(np.int64) * l_chips[win]
             cost_out[t0:t1, seg_server] = np.take_along_axis(cost32, win, axis=1)
             value_out[t0:t1, seg_server] = np.take_along_axis(value, win, axis=1)
+            if spot_on:
+                spot_out[t0:t1, seg_server] = np.take_along_axis(
+                    spot_k, win, axis=1
+                ).astype(np.int32)
+                required_out[t0:t1, seg_server] = np.take_along_axis(
+                    required, win, axis=1
+                ).astype(np.int32)
         # zero-load shortcut overlay: rate == 0 (or out_tokens == 0, which
         # shortcuts regardless of rate) replaces the sized pick
         zmask = ((r == 0.0) | out_zero[None, :]) & has_load[None, :]
@@ -1211,6 +1329,11 @@ def calculate_fleet_batch(
                       where=zmask)
             np.copyto(value_out[t0:t1], np.broadcast_to(zero_value, r.shape),
                       where=zmask)
+            if spot_on:
+                np.copyto(spot_out[t0:t1], np.broadcast_to(zero_spot, r.shape),
+                          where=zmask)
+                np.copyto(required_out[t0:t1],
+                          np.broadcast_to(np.int32(0), r.shape), where=zmask)
 
     return FleetBatchResult(
         servers=names,
@@ -1220,4 +1343,6 @@ def calculate_fleet_batch(
         chips=chips_out,
         cost=cost_out,
         value=value_out,
+        spot_replicas=spot_out,
+        required=required_out,
     )

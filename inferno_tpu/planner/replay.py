@@ -397,13 +397,30 @@ def replay_cycle_parity(
         replayed_reps = int(result.replicas[0, s])
         rec_acc = str(cyc.columns["accelerator"][j])
         rec_reps = int(cyc.columns["replicas"][j])
-        if replayed_acc != rec_acc or replayed_reps != rec_reps:
+        # spot placement replays bit-faithfully too: the snapshot
+        # round-trips the tier config, so a spot-enabled replay must
+        # reproduce the recorded split (a tier-less snapshot — incl.
+        # every pre-spot artifact — computes no split and skips this)
+        spot_ok = True
+        if result.spot_replicas is not None:
+            spot_ok = (
+                int(result.spot_replicas[0, s])
+                == int(cyc.columns["spot_replicas"][j])
+            )
+        if replayed_acc != rec_acc or replayed_reps != rec_reps or not spot_ok:
             mismatches.append({
                 "variant": v,
                 "reason": reason,
-                "recorded": {"accelerator": rec_acc, "replicas": rec_reps},
+                "recorded": {
+                    "accelerator": rec_acc, "replicas": rec_reps,
+                    "spot_replicas": int(cyc.columns["spot_replicas"][j]),
+                },
                 "replayed": {
-                    "accelerator": replayed_acc, "replicas": replayed_reps
+                    "accelerator": replayed_acc, "replicas": replayed_reps,
+                    "spot_replicas": (
+                        int(result.spot_replicas[0, s])
+                        if result.spot_replicas is not None else 0
+                    ),
                 },
             })
     return {

@@ -52,6 +52,11 @@ DEGRADE_SHAPE = "shape"  # allocated a value-worse (non-preferred) shape
 DEGRADE_INT8 = "int8"  # the worse shape is a quantized -int8 catalog entry
 DEGRADE_REPLICAS = "replicas"  # best-effort scaled replicas below the SLO count
 DEGRADE_ZEROED = "zeroed"  # nothing fit; variant got no allocation
+# spot placement demoted to all-reserved: the spot tier (or the reserved
+# headroom the pre-positioner must hold for its blast radius) could not
+# be taken, so the variant keeps its shape and replica count at the
+# undiscounted reserved price (spot/market.demote_spot)
+DEGRADE_SPOT_HEADROOM = "spot_headroom"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,6 +109,18 @@ class CapacityLedger:
             getattr(system, "quotas", {}) or {}
         )
         self._acc_buckets: dict[str, tuple[str, ...]] = {}
+        # spot tier (spot/market.py): per-pool preemptible budgets (a
+        # tier with chips == 0 is elastic and absent here), the blast
+        # radius driving the reserved-headroom charge, and the headroom
+        # chips currently HELD free per pool (the pre-positioner state,
+        # surfaced as inferno_reserved_headroom_chips)
+        self.spot_specs: dict = dict(getattr(system, "spot", {}) or {})
+        self.spot_available: dict[str, int] = {
+            pool: spec.chips
+            for pool, spec in self.spot_specs.items()
+            if spec.chips > 0
+        }
+        self.headroom_held: dict[str, int] = {}
 
     def buckets_for(self, acc_name: str) -> tuple[str, ...]:
         """Quota bucket keys (beyond the pool budget) this shape draws
@@ -156,6 +173,61 @@ class CapacityLedger:
         for k in self.buckets_for(acc_name):
             if self.quota_available.get(k, 0) < need:
                 return k, need - self.quota_available.get(k, 0)
+        return pool, 0
+
+    # -- spot-split accounting (spot/market.py) -----------------------------
+    # A candidate with spot replicas draws THREE charges: its reserved
+    # chips plus the blast-radius headroom from every reserved bucket
+    # (pool budget + quotas — held slack, not allocated), and its spot
+    # chips from the pool's spot budget. A candidate without spot
+    # replicas reduces exactly to the plain fits/take/shortfall above.
+
+    def _spot_needs(self, acc_name: str, alloc, per_replica: int):
+        """(pool, reserved+headroom chips, spot chips) of one candidate;
+        None when it carries no spot placement."""
+        if not alloc.spot_replicas:
+            return None
+        pool = self._pool(acc_name)
+        spec = self.spot_specs.get(pool)
+        if spec is None:  # stale candidate from a tier-less solve
+            return None
+        from inferno_tpu.spot.market import split_needs
+
+        reserved, spot, headroom = split_needs(alloc, per_replica, spec.blast_radius)
+        return pool, reserved + headroom, spot
+
+    def fits_alloc(self, acc_name: str, alloc, per_replica: int) -> bool:
+        needs = self._spot_needs(acc_name, alloc, per_replica)
+        if needs is None:
+            return self.fits(acc_name, alloc.num_replicas * per_replica)
+        pool, reserved_need, spot_need = needs
+        if not self.fits(acc_name, reserved_need):
+            return False
+        avail = self.spot_available.get(pool)
+        return avail is None or avail >= spot_need
+
+    def take_alloc(self, acc_name: str, alloc, per_replica: int) -> None:
+        needs = self._spot_needs(acc_name, alloc, per_replica)
+        if needs is None:
+            self.take(acc_name, alloc.num_replicas * per_replica)
+            return
+        pool, reserved_need, spot_need = needs
+        self.take(acc_name, reserved_need)
+        if pool in self.spot_available:
+            self.spot_available[pool] -= spot_need
+        held = reserved_need - (alloc.num_replicas - alloc.spot_replicas) * per_replica
+        self.headroom_held[pool] = self.headroom_held.get(pool, 0) + held
+
+    def shortfall_alloc(self, acc_name: str, alloc, per_replica: int) -> tuple[str, int]:
+        needs = self._spot_needs(acc_name, alloc, per_replica)
+        if needs is None:
+            return self.shortfall(acc_name, alloc.num_replicas * per_replica)
+        pool, reserved_need, spot_need = needs
+        if not self.fits(acc_name, reserved_need):
+            return self.shortfall(acc_name, reserved_need)
+        avail = self.spot_available.get(pool)
+        if avail is not None and avail < spot_need:
+            return f"{pool}:spot", spot_need - avail
         return pool, 0
 
 
@@ -279,8 +351,8 @@ def _allocate(
         acc_name, per_replica = pool_chips
         need = alloc.num_replicas * per_replica
 
-        if ledger.fits(acc_name, need):
-            ledger.take(acc_name, need)
+        if ledger.fits_alloc(acc_name, alloc, per_replica):
+            ledger.take_alloc(acc_name, alloc, per_replica)
             server.set_allocation(alloc)
             if top.cur_index > 0:
                 record_degradation(
@@ -288,9 +360,40 @@ def _allocate(
                     _classify_step(top.allocations[0].accelerator, alloc.accelerator),
                     alloc, alloc.num_replicas,
                 )
+        elif alloc.spot_replicas and ledger.fits(acc_name, need):
+            # pre-positioner fallback: the spot tier (or the reserved
+            # headroom its blast radius demands) can't be taken, but the
+            # whole placement fits reserved — keep the shape and replica
+            # count at the undiscounted price, and surface the lost
+            # discount as a spot_headroom DegradationEvent anchored at
+            # the split attempt's binding bucket (read BEFORE the
+            # reserved take below mutates the books)
+            from inferno_tpu.spot.market import demote_spot
+
+            if top.cur_index == 0:
+                top.pending_shortfall = ledger.shortfall_alloc(
+                    acc_name, alloc, per_replica
+                )
+            ledger.take(acc_name, need)
+            demoted = demote_spot(alloc)
+            server.set_allocation(demoted)
+            if top.cur_index == 0:
+                record_degradation(
+                    system, top, DEGRADE_SPOT_HEADROOM, demoted,
+                    demoted.num_replicas,
+                )
+            else:
+                record_degradation(
+                    system, top,
+                    _classify_step(top.allocations[0].accelerator,
+                                   demoted.accelerator),
+                    demoted, demoted.num_replicas,
+                )
         else:
             if top.cur_index == 0:
-                top.pending_shortfall = ledger.shortfall(acc_name, need)
+                top.pending_shortfall = ledger.shortfall_alloc(
+                    acc_name, alloc, per_replica
+                )
             top.cur_index += 1
             if top.cur_index + 1 < len(top.allocations):
                 top.delta = (
@@ -337,13 +440,30 @@ def _best_effort(
 
 def _scaled(alloc: Allocation, num_replicas: int) -> Allocation:
     """Clone with replica count reduced to what fits, cost/value scaled
-    proportionally (reference: pkg/solver/greedy.go:206-211, 305-310)."""
+    proportionally (reference: pkg/solver/greedy.go:206-211, 305-310).
+
+    Best-effort candidates are always DEMOTED off the spot tier first
+    (`_reserved_only`), so the proportional cost scaling here operates
+    on the undiscounted reserved price."""
     factor = num_replicas / alloc.num_replicas
     out = alloc.clone()
     out.cost *= factor
     out.value *= factor
     out.num_replicas = num_replicas
     return out
+
+
+def _reserved_only(alloc: Allocation) -> Allocation:
+    """Best-effort placements never gamble on the spot tier: a variant
+    already conceding replicas (or its whole SLO count) to capacity
+    pressure must not also carry eviction risk, and the round-robin /
+    maximal fill arithmetic stays whole-chip-exact on one bucket. A
+    candidate with spot replicas is demoted to all-reserved pricing."""
+    if not alloc.spot_replicas:
+        return alloc
+    from inferno_tpu.spot.market import demote_spot
+
+    return demote_spot(alloc)
 
 
 def _record_best_effort(
@@ -371,6 +491,7 @@ def _allocate_maximally(
             continue
         placed = False
         for alloc in entry.allocations:
+            alloc = _reserved_only(alloc)
             pool_chips = _chips_per_replica(system, entry.server_name, alloc)
             if pool_chips is None:
                 continue
@@ -421,6 +542,7 @@ def _allocate_equally(
                 continue
             if not ticket.active:
                 for alloc in entry.allocations:
+                    alloc = _reserved_only(alloc)
                     pool_chips = _chips_per_replica(system, name, alloc)
                     if pool_chips is None:
                         continue

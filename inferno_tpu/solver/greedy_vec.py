@@ -44,6 +44,7 @@ from inferno_tpu.config.defaults import (
 from inferno_tpu.config.types import OptimizerSpec
 from inferno_tpu.core.system import System
 from inferno_tpu.solver.greedy import (
+    DEGRADE_SPOT_HEADROOM,
     DEGRADE_ZEROED,
     DegradationEvent,
     _best_effort,
@@ -112,6 +113,38 @@ class _ArrayLedger:
         self.rank_pid = np.asarray(rank_pid, np.int64)
         self.rank_q1 = np.asarray(rank_q1, np.int64)
         self.rank_q2 = np.asarray(rank_q2, np.int64)
+        # spot tier (spot/market.py): per-rank blast radius (0 = the
+        # rank's pool has no tier) and the bounded spot budgets; a tier
+        # with chips == 0 is elastic and gets no bucket (rank_spot -1).
+        # Bucket semantics mirror greedy.CapacityLedger exactly: a spot
+        # candidate charges reserved chips + blast-radius headroom to
+        # every reserved bucket and its spot chips to the spot budget.
+        self.spot_specs = dict(getattr(system, "spot", {}) or {})
+        spot_pools: list[str] = []
+        spot_id: dict[str, int] = {}
+        rank_spot, rank_blast = [], []
+        for name in accs:
+            acc = system.accelerators[name]
+            spec = self.spot_specs.get(acc.pool)
+            if spec is None:
+                rank_spot.append(-1)
+                rank_blast.append(0.0)
+                continue
+            rank_blast.append(spec.blast_radius)
+            if spec.chips > 0:
+                sid = spot_id.setdefault(acc.pool, len(spot_pools))
+                if sid == len(spot_pools):
+                    spot_pools.append(acc.pool)
+                rank_spot.append(sid)
+            else:
+                rank_spot.append(-1)
+        self.spot_pools = spot_pools
+        self.spot_remaining = np.asarray(
+            [self.spot_specs[p].chips for p in spot_pools], np.int64
+        )
+        self.rank_spot = np.asarray(rank_spot, np.int64)
+        self.rank_blast = np.asarray(rank_blast, np.float64)
+        self.headroom_held: dict[str, int] = {}
 
     # -- rank-addressed (the vectorized loop) -------------------------------
 
@@ -149,6 +182,48 @@ class _ArrayLedger:
                 return self.quota_keys[q], int(need - self.quota_remaining[q])
         return self.pools[pid], 0
 
+    # -- spot-split accounting (mirrors CapacityLedger.*_alloc) -------------
+
+    def needs_rank(self, rank: int, reps: int, spot_k: int, chips: int):
+        """(reserved+headroom chips, spot chips) of one candidate row."""
+        spot = spot_k * chips
+        reserved = (reps - spot_k) * chips
+        if spot:
+            from inferno_tpu.spot.market import headroom_chips
+
+            reserved += headroom_chips(float(self.rank_blast[rank]), spot)
+        return reserved, spot
+
+    def fits_rank_split(self, rank: int, reserved_need: int, spot_need: int) -> bool:
+        if not self.fits_rank(rank, reserved_need):
+            return False
+        if spot_need:
+            sid = self.rank_spot[rank]
+            if sid >= 0 and self.spot_remaining[sid] < spot_need:
+                return False
+        return True
+
+    def take_rank_split(self, rank: int, reserved_need: int, spot_need: int,
+                        reserved_chips: int) -> None:
+        self.take_rank(rank, reserved_need)
+        sid = self.rank_spot[rank]
+        if spot_need and sid >= 0:
+            self.spot_remaining[sid] -= spot_need
+        held = reserved_need - reserved_chips
+        if held:
+            pool = self.pools[self.rank_pid[rank]]
+            self.headroom_held[pool] = self.headroom_held.get(pool, 0) + held
+
+    def shortfall_rank_split(self, rank: int, reserved_need: int,
+                             spot_need: int) -> tuple[str, int]:
+        if not self.fits_rank(rank, reserved_need):
+            return self.shortfall_rank(rank, reserved_need)
+        sid = self.rank_spot[rank]
+        if spot_need and sid >= 0 and self.spot_remaining[sid] < spot_need:
+            pool = self.pools[self.rank_pid[rank]]
+            return f"{pool}:spot", int(spot_need - self.spot_remaining[sid])
+        return self.pools[self.rank_pid[rank]], 0
+
     # -- bulk (the fast bucket path) ----------------------------------------
 
     def bulk_fits(self, ranks: np.ndarray, needs: np.ndarray) -> bool:
@@ -181,6 +256,47 @@ class _ArrayLedger:
                     qids[m], weights=needs[m],
                     minlength=len(self.quota_remaining),
                 ).astype(np.int64)
+
+    def bulk_fits_split(
+        self, ranks: np.ndarray, reserved_needs: np.ndarray,
+        spot_needs: np.ndarray,
+    ) -> bool:
+        if not self.bulk_fits(ranks, reserved_needs):
+            return False
+        sids = self.rank_spot[ranks]
+        m = (sids >= 0) & (spot_needs > 0)
+        if m.any():
+            demand = np.bincount(
+                sids[m], weights=spot_needs[m],
+                minlength=len(self.spot_remaining),
+            )
+            if np.any(demand > self.spot_remaining):
+                return False
+        return True
+
+    def bulk_take_split(
+        self, ranks: np.ndarray, reserved_needs: np.ndarray,
+        spot_needs: np.ndarray, headroom: np.ndarray,
+    ) -> None:
+        self.bulk_take(ranks, reserved_needs)
+        sids = self.rank_spot[ranks]
+        m = (sids >= 0) & (spot_needs > 0)
+        if m.any():
+            self.spot_remaining -= np.bincount(
+                sids[m], weights=spot_needs[m],
+                minlength=len(self.spot_remaining),
+            ).astype(np.int64)
+        hm = headroom > 0
+        if hm.any():
+            per_pool = np.bincount(
+                self.rank_pid[ranks[hm]], weights=headroom[hm],
+                minlength=len(self.pools),
+            )
+            for pid in np.flatnonzero(per_pool):
+                pool = self.pools[pid]
+                self.headroom_held[pool] = (
+                    self.headroom_held.get(pool, 0) + int(per_pool[pid])
+                )
 
     # -- name-addressed (the scalar best-effort helpers) --------------------
 
@@ -245,6 +361,7 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
     ext_reps: list[int] = []
     ext_chips: list[int] = []
     ext_rank: list[int] = []
+    ext_spot: list[int] = []
     direct: dict[int, object] = {}  # global row -> Allocation (ext rows)
 
     e_pos: list[int] = []  # entry -> server position
@@ -273,6 +390,7 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
             ext_val.append(float(alloc.value))
             ext_cost.append(float(alloc.cost))
             ext_reps.append(int(alloc.num_replicas))
+            ext_spot.append(int(alloc.spot_replicas))
             if pc is None:
                 # the scalar loop drops the whole entry when it pops an
                 # unresolvable candidate; the sentinel replays that
@@ -295,9 +413,11 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
         g_reps = np.concatenate([cands.reps, np.asarray(ext_reps, np.int64)])
         g_chips = np.concatenate([cands.chips, np.asarray(ext_chips, np.int64)])
         g_rank = np.concatenate([cands.rank, np.asarray(ext_rank, np.int64)])
+        g_spot = np.concatenate([cands.spot_reps, np.asarray(ext_spot, np.int64)])
     else:
         g_value, g_cost = cands.value, cands.cost
         g_reps, g_chips, g_rank = cands.reps, cands.chips, cands.rank
+        g_spot = cands.spot_reps
     g_kind, g_lane = cands.kind, cands.lane
 
     e_pos_a = np.asarray(e_pos, np.int64)
@@ -356,13 +476,20 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
         """The SLO-satisfying pass over one priority bucket (or, in
         delayed mode, the whole fleet). Returns unallocated entry ids in
         the exact pop order the scalar loop would produce."""
-        # fast bucket path: the whole group's preferred demand fits
+        # fast bucket path: the whole group's preferred demand fits —
+        # reserved chips + blast-radius headroom against the reserved
+        # buckets, spot chips against the spot budgets (identical to
+        # the plain needs when no row carries spot replicas)
         firsts = e_start_a[group]
         if np.all(g_chips[firsts] >= 0):
-            needs = g_reps[firsts] * g_chips[firsts]
+            spot_chips = g_spot[firsts] * g_chips[firsts]
             ranks = g_rank[firsts]
-            if ledger.bulk_fits(ranks, needs):
-                ledger.bulk_take(ranks, needs)
+            headroom = np.ceil(
+                ledger.rank_blast[ranks] * spot_chips
+            ).astype(np.int64)
+            res_needs = (g_reps[firsts] - g_spot[firsts]) * g_chips[firsts] + headroom
+            if ledger.bulk_fits_split(ranks, res_needs, spot_chips):
+                ledger.bulk_take_split(ranks, res_needs, spot_chips, headroom)
                 for e in group:
                     pos = int(e_pos_a[e])
                     servers_list[pos].set_allocation(
@@ -388,8 +515,13 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
                 continue  # unresolvable candidate: scalar drops the entry
             need = int(g_reps[row]) * chips
             rank = int(g_rank[row])
-            if ledger.fits_rank(rank, need):
-                ledger.take_rank(rank, need)
+            spot_k = int(g_spot[row])
+            res_need, spot_need = ledger.needs_rank(
+                rank, int(g_reps[row]), spot_k, chips
+            )
+            if ledger.fits_rank_split(rank, res_need, spot_need):
+                ledger.take_rank_split(rank, res_need, spot_need,
+                                       need - spot_need)
                 alloc = materialize(row, pos)
                 servers_list[pos].set_allocation(alloc)
                 if cur[e] > 0:
@@ -398,9 +530,34 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
                         _classify_step(preferred_shape(e)[0], alloc.accelerator),
                         alloc.accelerator, int(g_reps[row]),
                     )
+            elif spot_k and ledger.fits_rank(rank, need):
+                # pre-positioner fallback (scalar: the demote branch of
+                # greedy._allocate): spot tier or headroom unavailable,
+                # all-reserved placement at the undiscounted price; the
+                # shortfall is read BEFORE the take mutates the books
+                from inferno_tpu.spot.market import demote_spot
+
+                if cur[e] == 0:
+                    pending[e] = ledger.shortfall_rank_split(
+                        rank, res_need, spot_need
+                    )
+                ledger.take_rank(rank, need)
+                alloc = demote_spot(materialize(row, pos))
+                servers_list[pos].set_allocation(alloc)
+                if cur[e] == 0:
+                    emit(e, DEGRADE_SPOT_HEADROOM, alloc.accelerator,
+                         int(g_reps[row]))
+                else:
+                    emit(
+                        e,
+                        _classify_step(preferred_shape(e)[0], alloc.accelerator),
+                        alloc.accelerator, int(g_reps[row]),
+                    )
             else:
                 if cur[e] == 0:
-                    pending[e] = ledger.shortfall_rank(rank, need)
+                    pending[e] = ledger.shortfall_rank_split(
+                        rank, res_need, spot_need
+                    )
                 cur[e] += 1
                 nxt = int(e_start_a[e] + cur[e])
                 if nxt + 1 < int(e_end_a[e]):

@@ -1,0 +1,31 @@
+"""Spot-market fleet economics + eviction-storm injection (ISSUE-11).
+
+Mixed reserved/preemptible chip pools, threaded through the whole stack:
+
+* `market` — the risk model: `TPU_SPOT_POOLS` parsing with actionable
+  validation, the spot-replica split every sizing path applies (scalar
+  `create_allocation`, the vectorized fleet writeback, the batched
+  time-axis replay), and the reserved-headroom arithmetic the
+  limited-mode solvers pre-position.
+* `scenarios` — seeded correlated-storm generators (spot reclaims, zone
+  outages) and the offline evaluation that replays them against
+  `calculate_fleet_batch` output, reporting violation-seconds, recovery
+  time, and cost with and without pre-positioned headroom.
+* `injection` — the emulator-side fault injector: `EmulatedEngine`
+  preemption mid-run, and the deterministic closed-loop storm
+  comparison (`run_spot_storm_comparison`) the bench asserts on.
+"""
+
+from inferno_tpu.spot.market import (
+    SpotConfigError,
+    parse_pool_quotas,
+    parse_spot_pools,
+    spot_enabled,
+)
+
+__all__ = [
+    "SpotConfigError",
+    "parse_pool_quotas",
+    "parse_spot_pools",
+    "spot_enabled",
+]

@@ -13,8 +13,9 @@ def test_production_catalog_is_clean():
     # three predictive-scaling forecast gauges, the three fleet-scale
     # cycle instruments (query counter, cache-lookup gauge,
     # collect-concurrency histogram), the flight-recorder drop counter,
-    # and the four attainment/model-error scoreboard gauges
-    assert len(names) == 19
+    # the four attainment/model-error scoreboard gauges, and the three
+    # spot-market series (placement gauges + preemption counter)
+    assert len(names) == 22
     assert {"inferno_desired_replicas", "inferno_cycle_duration_seconds",
             "inferno_variant_analysis_seconds", "inferno_solver_seconds",
             "inferno_prom_scrape_seconds"} <= names

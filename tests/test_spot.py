@@ -1,0 +1,898 @@
+"""Spot-market fleet economics + eviction-storm injection (ISSUE-11).
+
+Covers the whole spot stack: TPU_SPOT_POOLS / TPU_POOL_QUOTAS validation
+with actionable errors, the risk model's spot split (safe slack vs risky
+replicas, discount vs premium), scalar<->vectorized sizing and greedy
+bit-parity with spot ENABLED, the limited-mode spot budgets + reserved-
+headroom pre-positioner (spot_headroom demotion events), batch T=1 spot
+parity, seeded storm-schedule determinism, the planner storm replay
+(pre-positioning strictly cuts violation-seconds), the deterministic
+closed-loop storm comparison, emulator preemption, recorder spot
+columns, and the spot_risk_bound / capacity-limited-after-eviction
+decision records.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from inferno_tpu.config.types import (
+    CapacitySpec,
+    OptimizerSpec,
+    SpotPoolSpec,
+)
+from inferno_tpu.core import System
+from inferno_tpu.obs import (
+    REASON_CAPACITY_LIMITED,
+    REASON_SPOT_RISK_BOUND,
+    DecisionRecord,
+)
+from inferno_tpu.parallel import calculate_fleet, reset_fleet_state
+from inferno_tpu.parallel.fleet import calculate_fleet_batch
+from inferno_tpu.planner.scenarios import base_rates_from_system, diurnal
+from inferno_tpu.solver.greedy import DEGRADE_SPOT_HEADROOM, solve_greedy
+from inferno_tpu.solver.greedy_vec import solve_greedy_fleet
+from inferno_tpu.solver.solver import solve_unlimited
+from inferno_tpu.spot.market import (
+    SpotConfigError,
+    demote_spot,
+    parse_pool_quotas,
+    parse_spot_pools,
+    premium_rate,
+    spot_split,
+)
+from inferno_tpu.spot.scenarios import (
+    STORM_GENERATORS,
+    build_storms,
+    replay_spot_storm,
+)
+from inferno_tpu.testing.fleet import (
+    fleet_capacity,
+    fleet_system_spec,
+)
+
+pytestmark = []
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_state():
+    reset_fleet_state()
+    yield
+    reset_fleet_state()
+
+
+# a tier where the risk premium BEATS the discount (all replicas ride
+# spot): premium = 0.001 * 0.5 * (180/3600) * 1000 = 0.025 < 0.5
+CHEAP_HAZARD = SpotPoolSpec(
+    discount=0.5, hazard_per_hr=0.001, blast_radius=0.5, recovery_s=180.0
+)
+# a tier where risk outweighs the discount (only storm-safe slack rides):
+# premium = 0.05 * 0.5 * (180/3600) * 1000 = 1.25 > 0.5
+RISKY_HAZARD = SpotPoolSpec(
+    discount=0.5, hazard_per_hr=0.05, blast_radius=0.5, recovery_s=180.0
+)
+
+
+def spot_spec(n=40, tier=CHEAP_HAZARD, chips=None, quotas=None, spot_chips=0,
+              fraction=None, **kw):
+    kw.setdefault("shapes_per_variant", 3)
+    kw.setdefault("priority_classes", 3)
+    spec = fleet_system_spec(n, **kw)
+    cap = {}
+    if fraction is not None:
+        cap = fleet_capacity(spec, fraction)
+        reset_fleet_state()
+        spec.optimizer = OptimizerSpec(unlimited=False)
+    tier = dataclasses.replace(tier, chips=spot_chips)
+    spec.capacity = CapacitySpec(
+        chips=chips if chips is not None else cap,
+        quotas=quotas or {},
+        spot={"v5e": tier},
+    )
+    return spec
+
+
+# -- config-parse validation (satellite 1) ------------------------------------
+
+
+def test_parse_spot_pools_round_trip():
+    pools = parse_spot_pools(json.dumps({
+        "v5e": {"discount": 0.6, "hazardPerHr": 0.05, "blastRadius": 0.25,
+                "recoverySeconds": 120, "chips": 64},
+    }))
+    assert pools["v5e"].discount == 0.6
+    assert pools["v5e"].blast_radius == 0.25
+    assert pools["v5e"].chips == 64
+    assert parse_spot_pools("") == {}
+
+
+@pytest.mark.parametrize("raw,needle", [
+    ("{broken", "not valid JSON"),
+    ("[1, 2]", "must be a JSON object"),
+    ('{"v5e": 3}', "'v5e'"),
+    ('{"v5e": {}}', '"discount"'),
+    ('{"v5e": {"discount": 1.5}}', "discount must be in (0, 1)"),
+    ('{"v5e": {"discount": 0.5, "blastRadius": 0}}', "blastRadius"),
+    ('{"v5e": {"discount": 0.5, "hazardPerHr": -1}}', "hazardPerHr"),
+])
+def test_parse_spot_pools_actionable_errors(raw, needle):
+    """A malformed entry names the offending key and the expected format
+    instead of raising KeyError/ValueError mid-cycle."""
+    with pytest.raises(SpotConfigError) as exc:
+        parse_spot_pools(raw)
+    assert needle in str(exc.value)
+    assert "TPU_SPOT_POOLS" in str(exc.value)
+    assert "discount" in str(exc.value)  # the expected format is spelled out
+
+
+@pytest.mark.parametrize("raw,needle", [
+    ("{broken", "not valid JSON"),
+    ('["v5e"]', "must be a JSON object"),
+    ('{"a/b/c": 4}', "'a/b/c'"),
+    ('{"/v5e": 4}', "'/v5e'"),
+    ('{"v5e": "lots"}', "whole chip count"),
+    ('{"v5e": -4}', ">= 0"),
+])
+def test_parse_pool_quotas_actionable_errors(raw, needle):
+    with pytest.raises(SpotConfigError) as exc:
+        parse_pool_quotas(raw)
+    assert needle in str(exc.value)
+    assert "TPU_POOL_QUOTAS" in str(exc.value)
+    assert "pool/region" in str(exc.value)
+
+
+def test_parse_pool_quotas_valid():
+    assert parse_pool_quotas('{"v5e": 48, "v5e/us-east1": 16}') == {
+        "v5e": 48, "v5e/us-east1": 16,
+    }
+
+
+def test_reconciler_ignores_malformed_spot_config_with_actionable_log():
+    """A ConfigMap typo must surface as one actionable error line and
+    cost only that key, never the cycle."""
+    import logging
+
+    from test_controller import CFG_NS, make_cluster, make_prom
+    from inferno_tpu.controller import Reconciler, ReconcilerConfig
+
+    cluster = make_cluster(replicas=1)
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {
+        "TPU_SPOT_POOLS": '{"v5e": {"discount": 99}}',
+        "TPU_POOL_QUOTAS": '{"a/b/c": 4}',
+    })
+    rec = Reconciler(
+        kube=cluster, prom=make_prom(arrival_rps=50.0),
+        config=ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar"),
+    )
+    records: list[logging.LogRecord] = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = _Capture(level=logging.ERROR)
+    rec.log.addHandler(handler)
+    try:
+        report = rec.run_cycle()
+    finally:
+        rec.log.removeHandler(handler)
+    assert report.optimization_ok
+    assert report.variants_applied == 1
+    text = "\n".join(r.getMessage() for r in records)
+    assert "TPU_SPOT_POOLS" in text and "discount must be in (0, 1)" in text
+    assert "TPU_POOL_QUOTAS" in text and "a/b/c" in text
+    # the malformed keys were ignored, not half-applied
+    _, capacity = rec.read_optimizer_and_capacity()
+    assert capacity.spot == {} and capacity.quotas == {}
+
+
+def test_reconciler_parses_spot_pools_from_configmap():
+    from test_controller import CFG_NS, make_cluster, make_prom
+    from inferno_tpu.controller import Reconciler, ReconcilerConfig
+
+    cluster = make_cluster(replicas=1)
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config", {
+        "TPU_SPOT_POOLS": '{"v5e": {"discount": 0.4, "blastRadius": 0.2}}',
+    })
+    rec = Reconciler(
+        kube=cluster, prom=make_prom(arrival_rps=50.0),
+        config=ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar"),
+    )
+    _, capacity = rec.read_optimizer_and_capacity()
+    assert capacity.spot["v5e"].discount == 0.4
+    assert capacity.spot["v5e"].blast_radius == 0.2
+
+
+# -- the risk model -----------------------------------------------------------
+
+
+def test_spot_split_safe_slack_rides_free():
+    """Replicas above the load-required count are storm-safe: up to
+    floor(slack / blast) ride spot with no premium."""
+    k, disc, prem, trimmed = spot_split(
+        reps=6, required=4, cost_per_replica=100.0,
+        discount=0.5, blast=0.5, premium=2.0, eligible=True,
+    )
+    # slack 2, blast 0.5 -> k_safe = 4; premium 2.0 > discount 0.5 so
+    # risky spot is NOT taken: k = min(6, 4) = 4, trimmed
+    assert int(k) == 4
+    assert float(disc) == 4 * 100.0 * 0.5
+    assert float(prem) == 0.0
+    assert bool(trimmed)
+
+
+def test_spot_split_cheap_risk_takes_everything():
+    k, disc, prem, trimmed = spot_split(
+        reps=6, required=4, cost_per_replica=100.0,
+        discount=0.5, blast=0.5, premium=0.1, eligible=True,
+    )
+    assert int(k) == 6
+    # the two replicas beyond the safe count carry the premium in the
+    # objective (never the price)
+    assert float(prem) == pytest.approx(2 * 100.0 * 0.1)
+    assert not bool(trimmed)
+
+
+def test_spot_split_ineligible_is_a_noop():
+    k, disc, prem, trimmed = spot_split(
+        reps=6, required=4, cost_per_replica=100.0,
+        discount=0.5, blast=0.5, premium=0.1, eligible=False,
+    )
+    assert int(k) == 0 and float(disc) == 0.0 and float(prem) == 0.0
+    assert not bool(trimmed)
+
+
+def test_premium_rate_formula():
+    assert premium_rate(RISKY_HAZARD) == pytest.approx(
+        0.05 * 0.5 * (180.0 / 3600.0) * 1000.0
+    )
+
+
+def test_scalar_sizing_applies_discount_and_premium():
+    spec = spot_spec(12, tier=CHEAP_HAZARD)
+    system = System(spec)
+    system.calculate_all()
+    solve_unlimited(system)
+    priced = [
+        s.allocation for s in system.servers.values()
+        if s.allocation and s.allocation.accelerator and s.allocation.spot_replicas
+    ]
+    assert priced, "cheap hazard must place spot"
+    for alloc in priced:
+        assert 0 < alloc.spot_replicas <= alloc.num_replicas
+        assert alloc.spot_discount > 0
+        # cost is the discounted price; demotion restores it exactly
+        restored = demote_spot(alloc)
+        assert restored.cost == pytest.approx(alloc.cost + alloc.spot_discount)
+        assert restored.spot_replicas == 0
+
+
+def test_disabled_spot_leaves_allocations_untouched():
+    """No TPU_SPOT_POOLS: every spot field is zero and cost equals the
+    plain reserved price — the bit-parity-with-pre-spot guarantee the
+    existing parity suites pin in depth."""
+    spec = fleet_system_spec(12, shapes_per_variant=2)
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    for s in system.servers.values():
+        a = s.allocation
+        if a is None:
+            continue
+        assert a.spot_replicas == 0
+        assert a.spot_discount == 0.0
+        assert a.spot_premium == 0.0
+        assert a.spot_trimmed is False
+
+
+def test_spot_ineligible_shape_stays_reserved():
+    spec = spot_spec(12, tier=CHEAP_HAZARD, shapes_per_variant=1,
+                     priority_classes=1)
+    for acc in spec.accelerators:
+        acc.spot_eligible = False
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    assert all(
+        (s.allocation is None) or s.allocation.spot_replicas == 0
+        for s in system.servers.values()
+    )
+
+
+# -- scalar <-> vectorized parity with spot ENABLED ---------------------------
+
+
+def _assert_bit_parity(scalar: System, fleet: System) -> None:
+    for name in scalar.servers:
+        sa = scalar.servers[name].allocation
+        sb = fleet.servers[name].allocation
+        assert (sa is None) == (sb is None), name
+        if sa is not None:
+            assert (
+                sa.accelerator, sa.num_replicas, sa.cost, sa.value,
+                sa.spot_replicas, sa.spot_discount,
+            ) == (
+                sb.accelerator, sb.num_replicas, sb.cost, sb.value,
+                sb.spot_replicas, sb.spot_discount,
+            ), name
+    assert scalar.degradations == fleet.degradations
+
+
+@pytest.mark.parametrize("tier,fraction,spot_chips", [
+    (CHEAP_HAZARD, 1.2, 0),   # loose capacity, elastic spot, all-spot
+    (CHEAP_HAZARD, 0.8, 24),  # binding + bounded spot -> demotions
+    (RISKY_HAZARD, 0.5, 16),  # trimming + deep capacity pressure
+    (CHEAP_HAZARD, 1.0, 8),   # exact capacity, tiny spot budget
+])
+def test_greedy_spot_parity_scalar_vs_vectorized(tier, fraction, spot_chips):
+    """The vectorized limited-mode solve must agree with the scalar
+    oracle bit-for-bit — allocations AND degradation events — with the
+    spot tier enabled, across trim regimes and spot-budget pressure."""
+    spec = spot_spec(40, tier=tier, fraction=fraction, spot_chips=spot_chips)
+    a, b = System(spec), System(spec)
+    calculate_fleet(a, backend="jax")
+    calculate_fleet(b, backend="jax")
+    solve_greedy(a, spec.optimizer)
+    solve_greedy_fleet(b, spec.optimizer)
+    _assert_bit_parity(a, b)
+
+
+def test_spot_headroom_demotion_event_and_ledger():
+    """A spot budget too small for the placement demotes candidates to
+    all-reserved: the event names the binding `pool:spot` bucket, and
+    the demoted allocation pays the undiscounted price."""
+    spec = spot_spec(40, tier=CHEAP_HAZARD, fraction=1.0, spot_chips=8)
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    solve_greedy_fleet(system, spec.optimizer)
+    events = [
+        e for e in system.degradations.values()
+        if e.step == DEGRADE_SPOT_HEADROOM
+    ]
+    assert events, "a tiny spot budget must demote someone"
+    for e in events:
+        assert e.pool.endswith(":spot")
+        assert e.shortfall_chips > 0
+        assert e.from_accelerator == e.to_accelerator  # shape kept
+        assert e.from_replicas == e.to_replicas  # replica count kept
+        alloc = system.servers[e.server].allocation
+        assert alloc is not None and alloc.spot_replicas == 0
+        assert alloc.spot_discount == 0.0
+
+
+def test_preposition_headroom_is_charged_to_reserved_buckets():
+    """The blast-radius headroom is HELD in the reserved pool: with spot
+    placed, the ledger's booked reserved chips exceed the reserved share
+    of the placement by exactly ceil(blast x spot chips) per pool."""
+    from inferno_tpu.solver.greedy import CapacityLedger
+
+    spec = spot_spec(20, tier=CHEAP_HAZARD, fraction=1.2)
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    ledger = CapacityLedger(system)
+    solve_greedy(system, spec.optimizer)
+    # re-run the books: the solve's ledger is internal, so replay the
+    # winners through a fresh one
+    from inferno_tpu.solver.greedy import _chips_per_replica
+
+    for name, server in system.servers.items():
+        alloc = server.allocation
+        if alloc is None or not alloc.accelerator:
+            continue
+        pc = _chips_per_replica(system, name, alloc)
+        assert pc is not None
+        ledger.take_alloc(pc[0], alloc, pc[1])
+    held = ledger.headroom_held.get("v5e", 0)
+    spot_chips = sum(
+        s.allocation.spot_replicas
+        * _chips_per_replica(system, n, s.allocation)[1]
+        for n, s in system.servers.items()
+        if s.allocation and s.allocation.spot_replicas
+    )
+    assert spot_chips > 0
+    # per-allocation ceil() makes the held total >= the pool-level
+    # ceil(blast x spot) bound and < it plus one chip per allocation
+    assert held >= int(np.ceil(CHEAP_HAZARD.blast_radius * spot_chips))
+
+
+# -- batched time-axis parity -------------------------------------------------
+
+
+def test_batch_t1_spot_parity_with_live_solve():
+    spec = spot_spec(30, tier=CHEAP_HAZARD)
+    system = System(spec)
+    rates = base_rates_from_system(system)[None, :]
+    result = calculate_fleet_batch(system, rates, backend="jax")
+    assert result.spot_replicas is not None and result.required is not None
+
+    live = System(spec)
+    calculate_fleet(live, backend="jax")
+    solve_unlimited(live)
+    for j, (name, server) in enumerate(live.servers.items()):
+        a = server.allocation
+        got = (
+            (-1, 0, 0) if a is None or not a.accelerator
+            else (result.accelerators.index(a.accelerator), a.num_replicas,
+                  a.spot_replicas)
+        )
+        want = (
+            int(result.choice[0, j]), int(result.replicas[0, j]),
+            int(result.spot_replicas[0, j]),
+        )
+        assert got == want, name
+
+
+def test_batch_without_spot_carries_no_spot_columns():
+    spec = fleet_system_spec(10, shapes_per_variant=1)
+    system = System(spec)
+    rates = base_rates_from_system(system)[None, :]
+    result = calculate_fleet_batch(system, rates, backend="jax")
+    assert result.spot_replicas is None and result.required is None
+
+
+# -- storm schedules (satellite 2: seed determinism) --------------------------
+
+
+def test_storm_schedules_are_seed_deterministic_regardless_of_selection():
+    """Same (scenario, seed) => bit-identical preemption schedule no
+    matter which other scenarios ride along (the PR 8 fixed-generator-
+    index convention)."""
+    alone = build_storms(["zone_outage"], ["v5e"], 48, 600.0, seed=3)
+    together = build_storms([], ["v5e"], 48, 600.0, seed=3)
+    assert alone[0].events == together[
+        list(STORM_GENERATORS).index("zone_outage")
+    ].events
+    rev = build_storms(
+        ["zone_outage", "spot_reclaim"], ["v5e"], 48, 600.0, seed=3
+    )
+    fwd = build_storms(
+        ["spot_reclaim", "zone_outage"], ["v5e"], 48, 600.0, seed=3
+    )
+    assert rev[0].events == fwd[1].events
+    assert rev[1].events == fwd[0].events
+    with pytest.raises(ValueError, match="unknown storm"):
+        build_storms(["quake"], ["v5e"], 48, 600.0)
+
+
+def test_storm_schedule_reproducible_and_seed_sensitive():
+    a = build_storms(["spot_reclaim"], ["v5e"], 96, 600.0, seed=11)[0]
+    b = build_storms(["spot_reclaim"], ["v5e"], 96, 600.0, seed=11)[0]
+    c = build_storms(["spot_reclaim"], ["v5e"], 96, 600.0, seed=12)[0]
+    assert a.events == b.events
+    assert a.events != c.events
+
+
+# -- planner storm replay -----------------------------------------------------
+
+
+def bench_tier():
+    """The bench's canonical tier: moderate discount, small blast
+    radius, hazard low enough that the risk model keeps the whole fleet
+    on spot (premium 0.005 * 0.06 * 0.5h * 1000 = 0.15 < 0.3 discount),
+    so the pre-positioned run differs from the risk-blind baseline by
+    exactly the held headroom."""
+    return SpotPoolSpec(
+        discount=0.3, hazard_per_hr=0.005, blast_radius=0.06,
+        recovery_s=1800.0,
+    )
+
+
+def test_replay_spot_storm_prepositioning_cuts_violations():
+    spec = fleet_system_spec(60, shapes_per_variant=2)
+    spec.capacity = CapacitySpec(chips={}, spot={"v5e": bench_tier()})
+    system = System(spec)
+    base = base_rates_from_system(system)
+    trace = diurnal(base, 24, 600.0, seed=0)
+    storms = build_storms(["spot_reclaim"], ["v5e"], 24, 600.0, seed=7)
+    schedule = dataclasses.replace(
+        storms[0],
+        events=tuple(
+            dataclasses.replace(e, fraction=min(e.fraction, 0.06))
+            for e in storms[0].events
+        ),
+    )
+    report = replay_spot_storm(spec, trace, schedule)
+    reactive = report["reactive"]
+    prepos = report["prepositioned"]
+    assert reactive["violation_seconds"] > 0
+    assert prepos["violation_seconds"] < reactive["violation_seconds"]
+    assert prepos["restored_replica_steps"] > 0
+    assert 0 < report["cost_delta_pct"] <= 10.0
+    # both solves replayed the same traffic: the reactive baseline's
+    # eviction exposure is strictly larger
+    assert reactive["evicted_replica_steps"] >= prepos["evicted_replica_steps"]
+    # bit-reproducible
+    reset_fleet_state()
+    again = replay_spot_storm(spec, trace, schedule)
+    assert again == report
+
+
+# -- deterministic closed-loop storm comparison -------------------------------
+
+
+def test_closed_loop_storm_comparison_strict_ordering():
+    from inferno_tpu.spot.injection import run_spot_storm_comparison
+
+    r = run_spot_storm_comparison()
+    assert r["spot_greedy"]["slo_violation_s"] > 0
+    assert (
+        r["prepositioned"]["slo_violation_s"]
+        < r["spot_greedy"]["slo_violation_s"]
+    )
+    assert 0 < r["cost_delta_pct"] <= 10.0
+    # deterministic: bit-identical reruns
+    assert run_spot_storm_comparison() == r
+
+
+def test_closed_loop_rejects_unknown_mode():
+    from inferno_tpu.spot.injection import run_spot_storm_loop, storm_scenario
+
+    with pytest.raises(ValueError, match="spot-greedy|prepositioned"):
+        run_spot_storm_loop(storm_scenario(), "yolo")
+
+
+# -- emulator preemption ------------------------------------------------------
+
+
+def test_engine_preempt_fails_inflight_and_refuses_new():
+    """preempt() is abrupt by design: in-flight requests fail with the
+    permanent-rejection contract and later submissions are refused.
+    (No virtual-time values are asserted, so this stays fast-tier.)"""
+    from inferno_tpu.emulator.engine import (
+        EmulatedEngine,
+        EngineProfile,
+        wait_for_result,
+    )
+
+    eng = EmulatedEngine(
+        EngineProfile(alpha=50.0, beta=0.5, max_batch=4), time_scale=1.0
+    )
+    eng.start()
+    try:
+        # out_tokens large enough that the request cannot complete before
+        # the preemption lands
+        req = eng.submit(in_tokens=16, out_tokens=100_000)
+        killed = eng.preempt()
+        assert killed == 1
+        result, rejected = wait_for_result(req, timeout=2.0)
+        assert result is None and rejected is True
+        late = eng.submit(in_tokens=16, out_tokens=8)
+        result, rejected = wait_for_result(late, timeout=0.1)
+        assert result is None and rejected is True
+        assert eng.preempted and eng.preempted_requests == 1
+        assert eng.num_running == 0 and eng.num_waiting == 0
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+def test_run_scenario_preemption_storm_kills_replicas():
+    """Closed-loop emulator run with mid-run evictions. SLOW TIER: the
+    PreemptionInjector polls wall-clock-derived virtual time, so on a
+    busy core the kill can land late relative to the emulated schedule —
+    the same emu-vs-wall flake class PRs 5/7/8 quarantined."""
+    from inferno_tpu.emulator.experiment import Scenario, run_scenario
+    from inferno_tpu.emulator.loadgen import RateSpec
+
+    # long decodes keep every engine busy for seconds of wall time, so
+    # the storm reliably catches work in flight. preempt_at is in
+    # EMULATED seconds: at time_scale 0.01 the virtual clock runs ~100x
+    # wall, so emu t=100s lands ~1 wall-second into the 3-second drive.
+    result = run_scenario(Scenario(
+        name="preempt-storm",
+        replicas=4,
+        rate=RateSpec(((3.0, 30.0),)),
+        in_tokens=64,
+        out_tokens=1500,
+        time_scale=0.01,
+        preempt_at=((100.0, 2),),  # a correlated storm: half the fleet
+    ))
+    assert result["preempted_requests"] > 0
+    # surviving replicas still completed work
+    assert result["requests"] > 0
+
+
+# -- recorder + decision records ----------------------------------------------
+
+
+def test_recorder_round_trips_spot_column(tmp_path):
+    from inferno_tpu.obs.recorder import (
+        FlightRecorder,
+        RecorderConfig,
+        read_artifact,
+    )
+
+    rec = FlightRecorder(RecorderConfig(dir=str(tmp_path)))
+    spec = fleet_system_spec(2, shapes_per_variant=1)
+    for cyc in range(2):
+        decisions = [
+            DecisionRecord(
+                variant=f"ns/v{i}", reason="slo_bound", replicas=3 + cyc,
+                spot_replicas=i + cyc, accelerator="v5e-4",
+            )
+            for i in range(2)
+        ]
+        rec.record_cycle(spec, decisions, {"seq": cyc, "ts": 1000.0 + cyc})
+    rec.close()
+    trace = read_artifact(str(tmp_path))
+    assert len(trace.cycles) == 2
+    assert list(trace.cycles[0].columns["spot_replicas"]) == [0, 1]
+    assert list(trace.cycles[1].columns["spot_replicas"]) == [1, 2]
+
+
+def test_decision_reason_spot_risk_bound():
+    """A live cycle against a risky tier explains the trimmed placement
+    with the new reason code."""
+    import test_controller as tc
+    from inferno_tpu.controller import Reconciler, ReconcilerConfig
+
+    cluster = tc.make_cluster(replicas=3)
+    cluster.set_configmap(tc.CFG_NS, "inferno-autoscaler-config", {
+        "TPU_SPOT_POOLS": json.dumps({
+            "v5e": {"discount": 0.5, "hazardPerHr": 0.05,
+                    "blastRadius": 0.5, "recoverySeconds": 180},
+        }),
+    })
+    rec = Reconciler(
+        kube=cluster, prom=tc.make_prom(arrival_rps=50.0),
+        config=ReconcilerConfig(
+            config_namespace=tc.CFG_NS, compute_backend="scalar"
+        ),
+    )
+    report = rec.run_cycle()
+    (d,) = report.decisions
+    assert d.reason == REASON_SPOT_RISK_BOUND
+    assert d.spot_replicas < d.replicas
+    assert "eviction risk" in d.detail
+
+
+def test_eviction_stranding_below_min_is_capacity_limited_with_shortfall():
+    """Satellite: an eviction that strands a variant below min replicas
+    must produce a capacity_limited DecisionRecord with the correct
+    chip shortfall, not a silent under-allocation. Cycle 1 sizes the
+    variant normally; a storm then reclaims most of the pool (the
+    post-eviction inventory is the new TPU_CAPACITY), and cycle 2 must
+    report the squeeze explicitly."""
+    import test_controller as tc
+    from inferno_tpu.controller import Reconciler, ReconcilerConfig
+
+    cluster = tc.make_cluster(replicas=3)
+    cluster.set_configmap(tc.CFG_NS, "inferno-autoscaler-config", {
+        "OPTIMIZER_MODE": "limited",
+        "TPU_CAPACITY": json.dumps({"v5e": 64}),
+        "TPU_SPOT_POOLS": json.dumps({"v5e": {"discount": 0.4}}),
+    })
+    rec = Reconciler(
+        kube=cluster, prom=tc.make_prom(arrival_rps=50.0),
+        config=ReconcilerConfig(
+            config_namespace=tc.CFG_NS, compute_backend="scalar"
+        ),
+    )
+    report = rec.run_cycle()
+    (d,) = report.decisions
+    assert d.reason != REASON_CAPACITY_LIMITED  # fits before the storm
+    needed = d.replicas
+
+    # the storm: all but 2 chips reclaimed — not even one v5e-4 replica
+    # (4 chips) fits, stranding the variant below its min of 1
+    cluster.set_configmap(tc.CFG_NS, "inferno-autoscaler-config", {
+        "OPTIMIZER_MODE": "limited",
+        "TPU_CAPACITY": json.dumps({"v5e": 2}),
+        "TPU_SPOT_POOLS": json.dumps({"v5e": {"discount": 0.4}}),
+    })
+    report = rec.run_cycle()
+    (d,) = report.decisions
+    assert d.reason == REASON_CAPACITY_LIMITED
+    assert d.degradation_step == "zeroed"
+    # exact arithmetic: the preferred candidate rode the spot tier
+    # entirely (hazard 0 < discount), so its binding RESERVED
+    # requirement is the pre-positioner's headroom,
+    # ceil(blast_radius x needed x 4 chips) = 2 x needed at the default
+    # 0.5 blast radius, against the 2 chips the eviction left
+    assert d.chip_shortfall == 2 * needed - 2
+    assert d.replicas == 1  # actuated floor, never a silent 0
+
+
+# -- review regressions -------------------------------------------------------
+
+
+def test_sizing_cache_replay_keeps_spot_premium_in_objective():
+    """Review fix: a cached cycle must solve the same objective as the
+    solved cycle it replays — the replayed value carries the spot risk
+    premium on top of the recomputed transition penalty."""
+    from inferno_tpu.config.types import AllocationData
+    from inferno_tpu.controller.sizing_cache import SizingCache
+    from inferno_tpu.core.allocation import (
+        Allocation,
+        allocation_from_data,
+        transition_penalty,
+    )
+
+    cached = Allocation(
+        accelerator="v5e-4", num_replicas=4, batch_size=32, cost=112.0,
+        spot_replicas=4, spot_discount=48.0, spot_premium=7.5,
+    )
+    cache = SizingCache(0.02)
+    cache.store("ns/v", ("sig",), 100.0, {"v5e-4": cached})
+    cur = allocation_from_data(AllocationData(accelerator="v5e-4",
+                                              num_replicas=2, cost=80.0))
+    out = cache.lookup("ns/v", ("sig",), 100.0, cur)
+    assert out is not None
+    replay = out["v5e-4"]
+    assert replay.value == transition_penalty(cur, replay) + 7.5
+
+
+def test_overlapping_storm_onsets_do_not_suppress_restoration():
+    """Review fix: the failover-latency gate is per event — a second
+    storm's onset must not strip headroom from the first storm's
+    already-restored victims, and each event's recovery time counts
+    only its own victims."""
+    from inferno_tpu.parallel.fleet import FleetBatchResult
+    from inferno_tpu.spot.scenarios import StormEvent, StormSchedule, evaluate_storms
+
+    spec = fleet_system_spec(
+        4, shapes_per_variant=1, tandem_every=0, zero_load_every=0,
+        pinned_every=0, infeasible_every=0,
+    )
+    spec.capacity = CapacitySpec(chips={}, spot={"v5e": SpotPoolSpec(
+        discount=0.3, hazard_per_hr=0.001, blast_radius=1.0,
+    )})
+    system = System(spec)
+    T, S = 6, 4
+    ones = np.ones((T, S), np.int32)
+    result = FleetBatchResult(
+        servers=list(system.servers),
+        accelerators=["v5e-4"],
+        choice=np.zeros((T, S), np.int32),
+        replicas=4 * ones,
+        chips=16 * np.ones((T, S), np.int64),
+        cost=np.full((T, S), 160.0, np.float32),
+        value=np.zeros((T, S), np.float64),
+        spot_replicas=4 * ones,  # everything on spot; headroom = chips
+        required=4 * ones,
+    )
+    # storm A onset step 1, window [1, 4); storm B onset step 2 inside
+    # A's window — at step 2, A's victims must restore onto headroom
+    schedule = StormSchedule(
+        name="overlap", seed=0, step_seconds=60.0,
+        events=(
+            StormEvent(step=1, pool="v5e", region="", fraction=0.5,
+                       recovery_steps=3, kind="spot_reclaim"),
+            StormEvent(step=2, pool="v5e", region="", fraction=0.25,
+                       recovery_steps=2, kind="spot_reclaim"),
+        ),
+    )
+    out = evaluate_storms(system, result, schedule, prepositioned=True)
+    restored = out["restored_replica_steps"]
+    assert restored > 0
+    # steps 2 and 3 carry restorable (non-onset) losses; with blast 1.0
+    # the headroom covers every non-onset loss, so only onset losses
+    # remain down and recovery attribution stays per event
+    reactive = evaluate_storms(system, result, schedule, prepositioned=False)
+    assert out["violation_seconds"] < reactive["violation_seconds"]
+    assert out["recovery_s_max"] <= reactive["recovery_s_max"]
+
+
+def test_parse_spot_pools_rejects_unknown_keys():
+    """Review fix: a misspelled optional key must raise the actionable
+    error, not silently default (hazard 0 turns the risk model off)."""
+    with pytest.raises(SpotConfigError) as exc:
+        parse_spot_pools('{"v5e": {"discount": 0.3, "hazardperhr": 0.5}}')
+    assert "hazardperhr" in str(exc.value)
+    assert "hazardPerHr" in str(exc.value)  # the expected spelling shown
+
+
+def test_limited_inventory_discovery_preserves_spot_tiers():
+    """Review fix: limited mode with discovered (not static) capacity
+    must carry the parsed spot tiers through discovery, like quotas."""
+    import test_controller as tc
+    from inferno_tpu.controller import Reconciler, ReconcilerConfig
+
+    cluster = tc.make_cluster(replicas=1)
+    cluster.set_configmap(tc.CFG_NS, "inferno-autoscaler-config", {
+        "OPTIMIZER_MODE": "limited",  # no TPU_CAPACITY: discovery path
+        "TPU_SPOT_POOLS": json.dumps({"v5e": {"discount": 0.4}}),
+        "TPU_POOL_QUOTAS": json.dumps({"v5e": 32}),
+    })
+    cluster.add_node("tpu-node", tpu_chips=64,
+                     accelerator="tpu-v5-lite-podslice")
+    rec = Reconciler(
+        kube=cluster, prom=tc.make_prom(arrival_rps=50.0),
+        config=ReconcilerConfig(
+            config_namespace=tc.CFG_NS, compute_backend="scalar"
+        ),
+    )
+    _, capacity = rec.read_optimizer_and_capacity()
+    assert capacity.chips == {"v5e": 64}  # discovered
+    assert capacity.quotas == {"v5e": 32}  # survived
+    assert capacity.spot["v5e"].discount == 0.4  # survived too
+
+
+def test_preemption_not_double_counted_across_failing_cycles():
+    """Review fix: if a cycle fails before the baseline refreshes, the
+    next cycle must not re-count the same eviction. The in-cycle
+    detector lowers the stored baseline as soon as it counts."""
+    from inferno_tpu.controller import Reconciler, ReconcilerConfig
+    from inferno_tpu.controller.promclient import FakeProm
+    import test_controller as tc
+
+    cluster = tc.make_cluster(replicas=4)
+    rec = Reconciler(
+        kube=cluster, prom=tc.make_prom(arrival_rps=50.0),
+        config=ReconcilerConfig(
+            config_namespace=tc.CFG_NS, compute_backend="scalar"
+        ),
+    )
+    rec._prev_spot = {f"llama-premium:{tc.NS}": (4, 4, "v5e")}
+    cluster.scale_deployment(tc.NS, "llama-premium", 2)
+    rec.run_cycle()
+    assert rec.spot_instruments.preemptions.get({"pool": "v5e"}) == 2.0
+    # simulate the cycle having failed before _publish_spot: force the
+    # post-count baseline back in and run again at the same replica count
+    rec._prev_spot = {f"llama-premium:{tc.NS}": (2, 2, "v5e")}
+    rec.run_cycle()
+    assert rec.spot_instruments.preemptions.get({"pool": "v5e"}) == 2.0
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+def test_spot_instruments_gauges_and_preemption_counter():
+    from inferno_tpu.controller.metrics import Registry, SpotInstruments
+
+    reg = Registry()
+    spot = SpotInstruments(reg)
+    spot.set_pool("v5e", spot_replicas=12, headroom_chips=24)
+    spot.count_preemptions("v5e", 3)
+    spot.count_preemptions("v5e", 0)  # no-op
+    text = reg.render()
+    assert 'inferno_spot_replicas{pool="v5e"} 12' in text
+    assert 'inferno_reserved_headroom_chips{pool="v5e"} 24' in text
+    assert 'inferno_preemptions_total{pool="v5e"} 3' in text
+    spot.zero_missing_pools(set())
+    assert 'inferno_spot_replicas{pool="v5e"} 0' in reg.render()
+
+
+def test_cycle_publishes_spot_gauges_and_detects_preemption():
+    """Two cycles: the first places spot and publishes the gauges; the
+    second observes fewer live replicas than desired on a spot-placed
+    variant and counts a detected preemption."""
+    import test_controller as tc
+    from inferno_tpu.controller import Reconciler, ReconcilerConfig
+    from inferno_tpu.controller.metrics import (
+        METRIC_PREEMPTIONS,
+        METRIC_SPOT_REPLICAS,
+    )
+
+    cluster = tc.make_cluster(replicas=4)
+    cluster.set_configmap(tc.CFG_NS, "inferno-autoscaler-config", {
+        # negligible hazard: the whole placement rides spot
+        "TPU_SPOT_POOLS": json.dumps({
+            "v5e": {"discount": 0.5, "hazardPerHr": 0.0001,
+                    "blastRadius": 0.5},
+        }),
+    })
+    rec = Reconciler(
+        kube=cluster, prom=tc.make_prom(arrival_rps=50.0),
+        config=ReconcilerConfig(
+            config_namespace=tc.CFG_NS, compute_backend="scalar"
+        ),
+    )
+    report = rec.run_cycle()
+    (d,) = report.decisions
+    assert d.spot_replicas == d.replicas > 0
+    text = rec.emitter.registry.render()
+    assert METRIC_SPOT_REPLICAS + '{pool="v5e"}' in text
+    assert METRIC_PREEMPTIONS in text
+    # the detection baseline is what was both running AND desired: the
+    # 4 deployed replicas (desired is larger, still spinning up)
+    baseline = min(4, d.replicas)
+
+    # the eviction: two pods vanish below the baseline
+    lost = 2
+    cluster.scale_deployment(tc.NS, "llama-premium", baseline - lost)
+    rec.run_cycle()
+    counted = rec.spot_instruments.preemptions.get({"pool": "v5e"})
+    assert counted == float(lost)
