@@ -7,7 +7,7 @@ CLUSTER ?= inferno-tpu
 
 .PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
         bench-sizing bench-capacity bench-planner bench-recorder \
-        bench-spot native lint lint-metrics \
+        bench-spot bench-profile perf-gate native lint lint-metrics \
         manifests-sync docker-build deploy-kind deploy undeploy clean
 
 all: native test
@@ -76,6 +76,23 @@ bench-recorder:
 # violation-seconds at <= 10% cost overhead; recorded in bench_full.json
 bench-spot:
 	$(PYTHON) bench.py --spot
+
+# Cycle-profiler benchmark (ISSUE-12): interleaved profiler-off/on
+# reconcile cycles; ASSERTS profiler overhead <= 1% of the PR 5
+# reference cycle; per-phase wall/CPU + jit compile-vs-execute
+# attribution recorded in bench_full.json
+bench-profile:
+	$(PYTHON) bench.py --profile
+
+# Perf-regression gate (ISSUE-12, CI): run the fast bench points
+# (--quick --profile), then diff the freshly-measured candidate
+# (bench_profile.json — ONLY this run's numbers, never stale blocks a
+# previous full bench left in bench_full.json) against the committed
+# BENCH_r trajectory tip with repeat-noise bands; non-zero exit names
+# the regressed phase/metric
+perf-gate:
+	$(PYTHON) bench.py --profile --quick
+	$(PYTHON) -m inferno_tpu.obs.perfdiff auto bench_profile.json --gate
 
 # Build the native C++ solver in place (also built on demand at import).
 native:

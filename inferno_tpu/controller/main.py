@@ -74,6 +74,17 @@ controller.go:516-582):
                                 scoreboard in (0,1] (default 0.2; see
                                 /debug/attainment and the
                                 inferno_model_error_* gauges)
+  CYCLE_PROFILER                true|false (default true): per-cycle cost
+                                attribution — phase wall/CPU, jit
+                                compile-vs-execute, memo/cache hit counts —
+                                served at /debug/profile, exported as
+                                inferno_profile_* series, recorded by the
+                                flight recorder (docs/observability.md;
+                                <=1% overhead, `make bench-profile`)
+  PROFILE_TRACEMALLOC           true|false (default false): additionally
+                                sample the tracemalloc traced-memory peak
+                                per cycle (costs CPU; excluded from the
+                                profiler's 1% overhead contract)
   TPU_SPOT_POOLS                fallback for the ConfigMap key of the same
                                 name: per-pool preemptible (spot) tiers —
                                 discount, eviction hazard, blast radius —
@@ -195,6 +206,10 @@ def main() -> int:
         attainment_ewma_gain=float(
             os.environ.get("ATTAINMENT_EWMA_GAIN", "0.2") or 0.2
         ),
+        # cycle profiler (docs/observability.md): default-on per-cycle
+        # cost attribution; tracemalloc sampling opt-in (it costs CPU)
+        cycle_profiler=env_bool("CYCLE_PROFILER", True),
+        profiler_tracemalloc=env_bool("PROFILE_TRACEMALLOC"),
     )
     rec = Reconciler(
         kube=kube, prom=prom, config=config, emitter=emitter, trace_buffer=traces
@@ -207,6 +222,9 @@ def main() -> int:
         tls=TLSConfig.from_env(),
         traces=traces,
         attainment=rec.attainment,
+        # /debug/profile serves the reconciler's per-cycle profile ring
+        # (empty when CYCLE_PROFILER=false — the route still exists)
+        profiles=rec.profiles,
     )
     server.start()
     # dedicated probe port so liveness/readiness don't ride the metrics

@@ -199,6 +199,18 @@ class Registry:
         with self._lock:
             return [(s.name, s.help, s.kind) for s in self._series.values()]
 
+    def histograms(self) -> list[tuple[str, tuple[float, ...]]]:
+        """(name, bucket boundaries) of every registered histogram — the
+        bucket-sanity lint surface (obs/lint.py: boundaries must be
+        strictly increasing and finite, or the rendered cumulative
+        counts are silently wrong)."""
+        with self._lock:
+            return [
+                (s.name, s.buckets)
+                for s in self._series.values()
+                if isinstance(s, _Histogram)
+            ]
+
     def render(self) -> str:
         with self._lock:
             lines: list[str] = []
@@ -590,6 +602,100 @@ class SpotInstruments:
             self.preemptions.inc({LABEL_POOL: pool}, float(n))
 
 
+# Cycle-profiler series (obs/profiler.py, ISSUE-12). All carry the
+# inferno_ prefix AND a unit suffix per obs/lint.py; the per-phase label
+# set is bounded by the cycle's phase names (collect/analyze/solve/
+# actuate), and the budget-burn gauges prune phases that stop appearing.
+METRIC_PROFILE_PHASE = "inferno_profile_phase_seconds"
+METRIC_PROFILE_PHASE_CPU = "inferno_profile_phase_cpu_seconds"
+METRIC_PROFILE_BURN = "inferno_profile_budget_burn_ratio"
+METRIC_PROFILE_EVENTS = "inferno_profile_events_total"
+METRIC_PROFILE_COUNTER_MS = "inferno_profile_counter_ms"
+METRIC_PROFILE_MEM_PEAK = "inferno_profile_mem_peak_bytes"
+LABEL_PHASE = "phase"
+LABEL_EVENT = "event"
+LABEL_COUNTER = "counter"
+
+
+class ProfilerInstruments:
+    """Prometheus surface of the per-cycle profile documents: per-phase
+    wall/CPU latency histograms, a per-phase budget-burn gauge (the
+    fraction of the reconcile interval that phase consumed — burn > 1/N
+    phases means the cycle is outgrowing its interval), the typed
+    counters as labelled Prometheus counters (event counts and
+    accumulated milliseconds kept in separate series so each keeps one
+    unit), and the tracemalloc high-water gauge. Registered
+    unconditionally, like every other instrument block, so the metric
+    catalog (and `make lint-metrics`) is independent of whether
+    CYCLE_PROFILER is on."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self.phase = self.registry.histogram(
+            METRIC_PROFILE_PHASE,
+            "Wall-clock duration of one reconcile-cycle phase",
+        )
+        self.phase_cpu = self.registry.histogram(
+            METRIC_PROFILE_PHASE_CPU,
+            "Process-CPU time consumed during one reconcile-cycle phase",
+        )
+        self.burn = self.registry.gauge(
+            METRIC_PROFILE_BURN,
+            "Fraction of the reconcile interval the phase consumed last "
+            "cycle (budget burn; the phases of a healthy cycle sum well "
+            "below 1)",
+        )
+        self.events = self.registry.counter(
+            METRIC_PROFILE_EVENTS,
+            "Cycle-profiler event counts (jit compiles/dispatches, plan "
+            "and solve memo hits/misses, ledger bulk-vs-heap paths)",
+        )
+        self.counter_ms = self.registry.counter(
+            METRIC_PROFILE_COUNTER_MS,
+            "Cycle-profiler accumulated milliseconds by attribution "
+            "(jit compile vs execute, snapshot update, plan repack)",
+        )
+        self.mem_peak = self.registry.gauge(
+            METRIC_PROFILE_MEM_PEAK,
+            "tracemalloc traced-memory peak of the last profiled cycle "
+            "(0 until PROFILE_TRACEMALLOC sampling is enabled)",
+        )
+
+    def observe_profile(self, doc: dict, interval_seconds: float) -> None:
+        """Publish one per-cycle profile document (obs.profiler
+        build_profile_doc output)."""
+        phases = doc.get("phases", {})
+        budget_s = max(float(interval_seconds), 1.0)
+        for name, entry in phases.items():
+            labels = {LABEL_PHASE: name}
+            wall_ms = float(entry.get("wall_ms", 0.0))
+            self.phase.observe(labels, wall_ms / 1000.0)
+            if "cpu_ms" in entry:
+                self.phase_cpu.observe(labels, float(entry["cpu_ms"]) / 1000.0)
+            self.burn.set(labels, wall_ms / 1000.0 / budget_s)
+        # prune burn gauges of phases that stopped appearing (e.g. a
+        # cycle that exited before solve): a frozen burn value would
+        # misreport the phase as still consuming budget
+        for _, (labels, _v) in list(self.burn.values.items()):
+            if labels.get(LABEL_PHASE, "") not in phases:
+                self.burn.remove(labels)
+        mem_seen = False
+        for name, value in doc.get("counters", {}).items():
+            if name.endswith("_ms"):
+                if value > 0:
+                    self.counter_ms.inc({LABEL_COUNTER: name}, float(value))
+            elif name.endswith("_kb"):
+                mem_seen = True
+                self.mem_peak.set({}, float(value) * 1024.0)
+            elif value > 0:
+                self.events.inc({LABEL_EVENT: name}, float(value))
+        if not mem_seen:
+            # the documented contract: the series READS 0 until
+            # PROFILE_TRACEMALLOC sampling is on — an absent series would
+            # break absent-series alerts built on that promise
+            self.mem_peak.set({}, 0.0)
+
+
 class TLSConfig:
     """Serve-side TLS with cert reload (the reference uses certwatchers on
     its metrics endpoint, cmd/main.go:122-199). Certs are re-read when the
@@ -751,6 +857,52 @@ class HealthServer(_RouteServer):
         super().__init__(_probe_routes(ready_flag), port, host)
 
 
+class _QueryError(ValueError):
+    """Malformed /debug/* query parameters (rendered as a 400)."""
+
+
+def _bad_query(e: "_QueryError"):
+    return (400, "application/json", json.dumps({"error": str(e)}).encode())
+
+
+def parse_debug_query(
+    query: dict | None,
+    str_params: frozenset[str] | set[str] = frozenset(),
+    int_params: frozenset[str] | set[str] = frozenset(),
+) -> dict:
+    """THE query-parameter contract of every /debug/* route (decisions,
+    attainment, profile): unknown parameters, empty string values, and
+    non-positive/non-integer counts each raise _QueryError — a malformed
+    request is a 400, never a silent full-payload download. Returns only
+    the parameters present, validated and typed."""
+    query = query or {}
+    allowed = set(str_params) | set(int_params)
+    unknown = sorted(set(query) - allowed)
+    if unknown:
+        raise _QueryError(
+            f"unknown parameter(s) {unknown}; "
+            f"supported: {', '.join(sorted(allowed))}"
+        )
+    out: dict = {}
+    for key in sorted(str_params):
+        if key in query:
+            if not query[key]:
+                raise _QueryError(f"{key} must be a non-empty value")
+            out[key] = query[key]
+    for key in sorted(int_params):
+        if key in query:
+            try:
+                n = int(query[key])
+            except ValueError:
+                raise _QueryError(
+                    f"{key} must be an integer, got {query[key]!r}"
+                ) from None
+            if n < 1:
+                raise _QueryError(f"{key} must be >= 1, got {n}")
+            out[key] = n
+    return out
+
+
 def _decisions_route(traces):
     """The /debug/decisions handler: the last-K cycle traces, optionally
     narrowed by query filters so a large-fleet ring is inspectable
@@ -763,32 +915,20 @@ def _decisions_route(traces):
                        dwarf the filtered payload
 
     Unknown or malformed parameters are a 400, never a silent
-    full-ring download."""
-
-    def _bad(msg: str):
-        return (400, "application/json", json.dumps({"error": msg}).encode())
+    full-ring download (parse_debug_query — shared with /debug/profile
+    and /debug/attainment)."""
 
     def decisions(query=None):
-        query = query or {}
-        unknown = sorted(set(query) - {"variant", "cycles"})
-        if unknown:
-            return _bad(
-                f"unknown parameter(s) {unknown}; supported: variant, cycles"
+        try:
+            params = parse_debug_query(
+                query, str_params={"variant"}, int_params={"cycles"}
             )
-        variant = query.get("variant", "")
-        if "variant" in query and not variant:
-            return _bad("variant must be a non-empty variant id")
-        n_cycles = None
-        if "cycles" in query:
-            try:
-                n_cycles = int(query["cycles"])
-            except ValueError:
-                return _bad(f"cycles must be an integer, got {query['cycles']!r}")
-            if n_cycles < 1:
-                return _bad(f"cycles must be >= 1, got {n_cycles}")
+        except _QueryError as e:
+            return _bad_query(e)
+        variant = params.get("variant", "")
         cycles = traces.snapshot()
-        if n_cycles is not None:
-            cycles = cycles[-n_cycles:]
+        if "cycles" in params:
+            cycles = cycles[-params["cycles"]:]
         if variant:
             cycles = [
                 {
@@ -808,6 +948,81 @@ def _decisions_route(traces):
     return decisions
 
 
+def _attainment_route(attainment):
+    """The /debug/attainment handler: the per-variant SLO-attainment /
+    model-error scoreboard, optionally narrowed to one variant:
+
+      ?variant=<id>    only that variant's scoreboard row (matched on
+                       the full variant id; an unknown id returns an
+                       empty `variants` map, mirroring the decisions
+                       route's never-reported-variant semantics)
+
+    Same 400-on-malformed contract as /debug/decisions
+    (parse_debug_query)."""
+
+    def route(query=None):
+        try:
+            params = parse_debug_query(query, str_params={"variant"})
+        except _QueryError as e:
+            return _bad_query(e)
+        doc = attainment.snapshot()
+        variant = params.get("variant", "")
+        if variant:
+            doc = {
+                **doc,
+                "variants": {
+                    k: v for k, v in doc.get("variants", {}).items()
+                    if k == variant
+                },
+            }
+        return (200, "application/json", json.dumps(doc, default=str).encode())
+
+    return route
+
+
+def _profile_route(profiles):
+    """The /debug/profile handler: the last-K per-cycle profile
+    documents (obs/profiler.py) — per-phase wall/CPU attribution plus
+    the typed counters — with filters matching /debug/decisions
+    semantics:
+
+      ?cycles=<N>      only the newest N cycles
+      ?phase=<name>    per cycle, only that phase's attribution; the
+                       fleet-wide counters map is omitted, mirroring how
+                       the variant filter omits the span tree
+
+    Unknown or malformed parameters are a 400 (parse_debug_query)."""
+
+    def route(query=None):
+        try:
+            params = parse_debug_query(
+                query, str_params={"phase"}, int_params={"cycles"}
+            )
+        except _QueryError as e:
+            return _bad_query(e)
+        cycles = profiles.snapshot()
+        if "cycles" in params:
+            cycles = cycles[-params["cycles"]:]
+        phase = params.get("phase", "")
+        if phase:
+            cycles = [
+                {
+                    **{k: v for k, v in cyc.items() if k != "counters"},
+                    "phases": {
+                        k: v for k, v in cyc.get("phases", {}).items()
+                        if k == phase
+                    },
+                }
+                for cyc in cycles
+            ]
+        body = json.dumps(
+            {"capacity": profiles.capacity, "cycles": cycles}, default=str
+        )
+        return (200, "application/json", body.encode())
+
+    return route
+
+
 class MetricsServer(_RouteServer):
     """Serves /metrics (plus the probe routes, for single-port setups) on
     a background thread. Given a TraceBuffer, also serves
@@ -816,7 +1031,12 @@ class MetricsServer(_RouteServer):
     jump?" endpoint, with `?variant=`/`?cycles=` filters for large
     fleets. Given an obs.attainment.AttainmentTracker, also serves
     /debug/attainment: the per-variant SLO-attainment / model-error
-    scoreboard (docs/observability.md)."""
+    scoreboard, with `?variant=` filtering (docs/observability.md).
+    Given a profile buffer (obs.TraceBuffer of per-cycle profile
+    documents), also serves /debug/profile: the last-K cycles'
+    per-phase wall/CPU/counter attribution with `?cycles=`/`?phase=`
+    filters. All three debug routes share one query-param validation
+    contract (parse_debug_query): malformed input is a 400."""
 
     def __init__(
         self,
@@ -826,10 +1046,12 @@ class MetricsServer(_RouteServer):
         tls: TLSConfig | None = None,
         traces=None,  # obs.TraceBuffer
         attainment=None,  # obs.attainment.AttainmentTracker
+        profiles=None,  # obs.TraceBuffer of profile documents
     ):
         self.registry = registry
         self.traces = traces
         self.attainment = attainment
+        self.profiles = profiles
         self.ready_flag = {"ready": True}
 
         def metrics(query=None):
@@ -839,10 +1061,7 @@ class MetricsServer(_RouteServer):
         if traces is not None:
             routes["/debug/decisions"] = _decisions_route(traces)
         if attainment is not None:
-
-            def attainment_route(query=None):
-                body = json.dumps(attainment.snapshot(), default=str)
-                return (200, "application/json", body.encode())
-
-            routes["/debug/attainment"] = attainment_route
+            routes["/debug/attainment"] = _attainment_route(attainment)
+        if profiles is not None:
+            routes["/debug/profile"] = _profile_route(profiles)
         super().__init__(routes, port, host, tls=tls)

@@ -278,6 +278,20 @@ class ReconcilerConfig:
     # EWMA gain for the model-error / SLO-attainment scoreboard
     # (obs/attainment.py; env ATTAINMENT_EWMA_GAIN)
     attainment_ewma_gain: float = 0.2
+    # -- cycle profiler (ISSUE-12, obs/profiler.py) --------------------------
+    # per-cycle cost attribution: phase wall/CPU splits, jit
+    # compile-vs-execute, memo/cache hit-miss counts — aggregated into a
+    # profile document per cycle (served at /debug/profile, exported as
+    # inferno_profile_* series, recorded by the flight recorder).
+    # Default ON (env CYCLE_PROFILER): `make bench-profile` pins the
+    # overhead at <= 1% of the reference cycle, and profiling is
+    # observation-only — decisions are bit-identical either way
+    # (tests/test_profiler.py)
+    cycle_profiler: bool = True
+    # additionally sample the tracemalloc traced-memory peak per cycle
+    # (env PROFILE_TRACEMALLOC, default off: tracing costs real CPU and
+    # is excluded from the 1% overhead contract)
+    profiler_tracemalloc: bool = False
 
 
 @dataclasses.dataclass
@@ -310,6 +324,9 @@ class CycleReport:
     # root span of the cycle trace (obs/trace.py): collect -> analyze
     # (one child per variant) -> solve -> actuate
     trace: Span | None = None
+    # per-cycle profile document (obs/profiler.py, ISSUE-12): per-phase
+    # wall/CPU attribution + typed counters; None with CYCLE_PROFILER off
+    profile: dict | None = None
 
 
 class _CountingProm:
@@ -368,6 +385,7 @@ class Reconciler:
             CycleInstruments,
             ForecastInstruments,
             MetricsEmitter,
+            ProfilerInstruments,
             SpotInstruments,
         )
 
@@ -385,6 +403,13 @@ class Reconciler:
         # check: an EMPTY shared buffer is falsy — len() == 0 — and `or`
         # would silently disconnect it)
         self.traces = trace_buffer if trace_buffer is not None else TraceBuffer()
+        # cycle profiler (obs/profiler.py, ISSUE-12): the last-K profile
+        # documents, served at /debug/profile when main() hands this
+        # buffer to the MetricsServer. The instrument block registers
+        # unconditionally (lint parity); the buffer simply stays empty
+        # with CYCLE_PROFILER off.
+        self.profiles = TraceBuffer()
+        self.profiler_instruments = ProfilerInstruments(self.emitter.registry)
         # readiness heartbeat (metrics._probe_routes): run_cycle stamps
         # last_cycle_monotonic + max_cycle_age_s into this dict when set
         self.ready_flag: dict | None = None
@@ -1115,7 +1140,16 @@ class Reconciler:
         (collect -> analyze -> solve -> actuate) and one DecisionRecord
         per variant seen; both are also retained on the trace ring buffer
         for /debug/decisions and emitted as structured log events."""
-        tracer = Tracer("reconcile-cycle")
+        profiler = None
+        if self.config.cycle_profiler:
+            from inferno_tpu.obs.profiler import CycleProfiler
+
+            profiler = CycleProfiler(
+                sample_malloc=self.config.profiler_tracemalloc
+            ).activate()
+        # cpu=True only under the profiler: the plain trace document
+        # stays byte-identical to the pre-profiler format
+        tracer = Tracer("reconcile-cycle", cpu=profiler is not None)
         report = CycleReport(interval_seconds=self.config.interval_seconds)
         try:
             self._cycle(tracer, report)
@@ -1123,7 +1157,7 @@ class Reconciler:
             # every exit path — happy, early-return, raise — finishes the
             # trace, records the cycle histogram, and publishes the
             # heartbeat; an unexplainable cycle is the bug this PR removes
-            self._finish_cycle(tracer, report)
+            self._finish_cycle(tracer, report, profiler)
         return report
 
     def _cycle(self, tracer: Tracer, report: CycleReport) -> None:
@@ -1453,13 +1487,44 @@ class Reconciler:
             if rec.variant in cached_names:
                 rec.sizing_provenance = SIZING_PROVENANCE_CACHED
 
-    def _finish_cycle(self, tracer: Tracer, report: CycleReport) -> None:
+    def _finish_cycle(
+        self, tracer: Tracer, report: CycleReport, profiler=None
+    ) -> None:
         """Seal the cycle's observability outputs: attainment scoring,
-        trace, histogram, decision log events, ring-buffer entry, flight
-        recorder capture, readiness heartbeat."""
+        trace, profile document, histogram, decision log events,
+        ring-buffer entries, flight recorder capture, readiness
+        heartbeat."""
         root = tracer.finish()
         report.trace = root
         self.instruments.observe_cycle(root.duration_ms / 1000.0)
+        # one timestamp rendering for every per-cycle artifact (profile
+        # document, trace ring entry, recorder meta) — they must never
+        # disagree on when the cycle started
+        started_iso = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(tracer.started_at)
+        )
+        if profiler is not None:
+            from inferno_tpu.obs.profiler import build_profile_doc
+
+            profiler.deactivate()
+            # fold in the cycle-report counters the sites don't see:
+            # the sizing cache counts are tallied by the cache itself and
+            # the prom-query count by the per-cycle counting wrapper
+            profiler.counters["prom_queries"] = report.prom_queries
+            if self.sizing_cache is not None:
+                profiler.counters["sizing_cache_hits"] = report.sizing_cache_hits
+                profiler.counters["sizing_cache_misses"] = (
+                    report.sizing_cache_misses
+                )
+            report.profile = build_profile_doc(
+                root, profiler,
+                started_at=started_iso,
+                interval_seconds=report.interval_seconds,
+            )
+            self.profiles.append(report.profile)
+            self.profiler_instruments.observe_profile(
+                report.profile, report.interval_seconds
+            )
         # model-error / SLO-attainment scoreboard: score last cycle's
         # prediction against this cycle's observation and store this
         # cycle's prediction — BEFORE the records are logged/retained,
@@ -1490,9 +1555,7 @@ class Reconciler:
             kv(self.log, logging.INFO, "decision", **rec.to_dict())
         seq = self.traces.append(
             {
-                "started_at": time.strftime(
-                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime(tracer.started_at)
-                ),
+                "started_at": started_iso,
                 "duration_ms": round(root.duration_ms, 3),
                 "optimization_ok": report.optimization_ok,
                 "errors": list(report.errors),
@@ -1507,21 +1570,21 @@ class Reconciler:
         if self.recorder is not None:
             spec, self._cycle_spec = self._cycle_spec, None
             if spec is not None and report.decisions:
-                self.recorder.record_cycle(
-                    spec,
-                    report.decisions,
-                    {
-                        "seq": seq,
-                        "ts": tracer.started_at,
-                        "started_at": time.strftime(
-                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(tracer.started_at)
-                        ),
-                        "duration_ms": round(root.duration_ms, 3),
-                        "interval_seconds": report.interval_seconds,
-                        "optimization_ok": report.optimization_ok,
-                        "errors": len(report.errors),
-                    },
-                )
+                meta = {
+                    "seq": seq,
+                    "ts": tracer.started_at,
+                    "started_at": started_iso,
+                    "duration_ms": round(root.duration_ms, 3),
+                    "interval_seconds": report.interval_seconds,
+                    "optimization_ok": report.optimization_ok,
+                    "errors": len(report.errors),
+                }
+                if report.profile is not None:
+                    # profile column (ISSUE-12): the cycle's own cost
+                    # attribution rides the artifact — optional on read,
+                    # so pre-profiler recordings stay loadable
+                    meta["profile"] = report.profile
+                self.recorder.record_cycle(spec, report.decisions, meta)
             new_drops = self.recorder.dropped - self._recorder_dropped_seen
             if new_drops > 0:
                 self._recorder_dropped_seen = self.recorder.dropped

@@ -32,9 +32,17 @@ from inferno_tpu.obs.decision import (
     SIZING_PROVENANCE_SOLVED,
     DecisionRecord,
 )
+from inferno_tpu.obs.profiler import (
+    PROFILE_SCHEMA,
+    CycleProfiler,
+    build_profile_doc,
+)
 from inferno_tpu.obs.trace import Span, TraceBuffer, Tracer
 
 __all__ = [
+    "PROFILE_SCHEMA",
+    "CycleProfiler",
+    "build_profile_doc",
     "AttainmentConfig",
     "AttainmentScore",
     "AttainmentTracker",

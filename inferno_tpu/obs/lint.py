@@ -10,6 +10,7 @@ registry construction the production entry point uses.
 
 from __future__ import annotations
 
+import math
 import sys
 
 METRIC_NAME_PREFIX = "inferno_"
@@ -17,9 +18,10 @@ METRIC_NAME_PREFIX = "inferno_"
 # Unit-suffix convention: every series name ends in the unit it is
 # measured in. `_total` marks counters (unitless cumulative counts),
 # `_ratio` dimensionless gauges, the rest physical units (`_chips` and
-# `_replicas` are the capacity units of the spot/fleet gauges, ISSUE-11).
+# `_replicas` are the capacity units of the spot/fleet gauges, ISSUE-11;
+# `_bytes` the profiler's memory high-water gauge, ISSUE-12).
 UNIT_SUFFIXES = ("_seconds", "_ms", "_total", "_ratio", "_rpm", "_chips",
-                 "_replicas")
+                 "_replicas", "_bytes")
 
 # Grandfathered pre-convention names: these shipped before the suffix
 # rule and are part of the external actuation/dashboard contract, so
@@ -54,6 +56,24 @@ def lint_registry(registry) -> list[str]:
                 f"{name} ({kind}): missing a unit suffix "
                 f"({'|'.join(UNIT_SUFFIXES)}) and not allowlisted"
             )
+    # histogram bucket sanity (ISSUE-12): boundaries must be strictly
+    # increasing and finite. The registry constructor only rejects
+    # unsorted/empty tuples — duplicates and infinities pass it, and
+    # either renders broken cumulative counts (a duplicated `le` emits
+    # two conflicting lines; an explicit +Inf boundary collides with the
+    # synthesized overflow bucket).
+    for name, buckets in getattr(registry, "histograms", lambda: [])():
+        if any(not math.isfinite(b) for b in buckets):
+            violations.append(
+                f"{name} (histogram): non-finite bucket boundary in "
+                f"{tuple(buckets)} (the +Inf bucket is synthesized; "
+                f"explicit inf/nan boundaries corrupt the exposition)"
+            )
+        elif any(b2 <= b1 for b1, b2 in zip(buckets, buckets[1:])):
+            violations.append(
+                f"{name} (histogram): bucket boundaries not strictly "
+                f"increasing: {tuple(buckets)}"
+            )
     return violations
 
 
@@ -63,15 +83,17 @@ def build_controller_registry():
     histograms + fleet-cycle instruments + recorder drop counter
     (CycleInstruments), the predictive-scaling forecast gauges
     (ForecastInstruments), the SLO-attainment / model-error scoreboard
-    gauges (AttainmentInstruments), and the spot-market placement /
-    preemption series (SpotInstruments) — each registered
-    unconditionally, like the Reconciler does, so the catalog is
-    identical whatever features are enabled."""
+    gauges (AttainmentInstruments), the spot-market placement /
+    preemption series (SpotInstruments), and the cycle-profiler series
+    (ProfilerInstruments) — each registered unconditionally, like the
+    Reconciler does, so the catalog is identical whatever features are
+    enabled."""
     from inferno_tpu.controller.metrics import (
         AttainmentInstruments,
         CycleInstruments,
         ForecastInstruments,
         MetricsEmitter,
+        ProfilerInstruments,
         Registry,
         SpotInstruments,
     )
@@ -82,6 +104,7 @@ def build_controller_registry():
     ForecastInstruments(registry)
     AttainmentInstruments(registry)
     SpotInstruments(registry)
+    ProfilerInstruments(registry)
     return registry
 
 

@@ -468,6 +468,15 @@ class RecordedCycle:
     fingerprint: str  # fleet-snapshot fingerprint ("" = none recorded)
     variants: list[str]
     columns: dict[str, np.ndarray]
+    # per-cycle profile document (obs/profiler.py, ISSUE-12): the
+    # cycle's own cost attribution, recorded when the live controller
+    # ran with CYCLE_PROFILER on. OPTIONAL ON READ — pre-profiler
+    # artifacts (and profiler-off recordings) load with None, so adding
+    # the column never invalidated an archive (same contract as
+    # OPTIONAL_I32_FIELDS, but carried in the jsonl cycle line: the
+    # document is per-cycle, not per-variant, so the npz blocks are the
+    # wrong home for it)
+    profile: dict | None = None
 
 
 @dataclasses.dataclass
@@ -548,6 +557,40 @@ class RecordedTrace:
                 out[t, dst] = np.asarray(cyc.columns[field], np.float64)[src]
                 present[t, dst] = True
         return out, present
+
+    def profile_summary(self) -> dict | None:
+        """Aggregate cost attribution over the cycles that carry a
+        profile column (ISSUE-12): mean cycle/phase wall-ms plus summed
+        event counters. None when no recorded cycle has one (pre-
+        profiler artifact, or CYCLE_PROFILER was off) — renderers skip
+        the block rather than print zeros that read as a free cycle."""
+        profiled = [c.profile for c in self.cycles if c.profile]
+        if not profiled:
+            return None
+        n = len(profiled)
+        phases: dict[str, float] = {}
+        counters: dict[str, float] = {}
+        cycle_ms = 0.0
+        for doc in profiled:
+            cycle_ms += float((doc.get("cycle") or {}).get("wall_ms", 0.0))
+            for name, entry in (doc.get("phases") or {}).items():
+                phases[name] = phases.get(name, 0.0) + float(
+                    (entry or {}).get("wall_ms", 0.0)
+                )
+            for name, val in (doc.get("counters") or {}).items():
+                if isinstance(val, (int, float)):
+                    counters[name] = counters.get(name, 0.0) + float(val)
+        return {
+            "cycles_profiled": n,
+            "mean_cycle_ms": round(cycle_ms / n, 3),
+            "mean_phase_ms": {
+                k: round(v / n, 3) for k, v in sorted(phases.items())
+            },
+            "counters_total": {
+                k: (round(v, 3) if k.endswith(("_ms", "_kb")) else int(v))
+                for k, v in sorted(counters.items())
+            },
+        }
 
     def spec_doc_for(self, cycle_index: int = -1) -> dict:
         """The fleet-snapshot document of the given cycle (raises
@@ -684,6 +727,10 @@ def read_artifact(
                     fingerprint=str(doc.get("fingerprint", "") or ""),
                     variants=[str(v) for v in variants],
                     columns=columns,
+                    profile=(
+                        doc["profile"]
+                        if isinstance(doc.get("profile"), dict) else None
+                    ),
                 ))
     for w in warnings:
         (warn or log.warning)(w)

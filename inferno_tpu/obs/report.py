@@ -163,12 +163,17 @@ def main(argv=None) -> int:
     # fail the run (CI pipelines branch on this, table or --json alike)
     mismatched = sum(1 for r in rows.values() if r["replay"] == "MISMATCH")
 
+    # profile column (ISSUE-12): the recorded cycles' own cost
+    # attribution, aggregated — None for pre-profiler artifacts
+    profile = recorded.profile_summary()
+
     if args.json:
         print(json.dumps({
             "trace_dir": recorded.dir,
             "cycles": recorded.num_cycles,
             "ewma_gain": args.ewma_gain,
             "replay_mismatches": mismatched,
+            "profile": profile,
             "variants": dict(ordered),
         }, indent=1))
         return 1 if mismatched else 0
@@ -176,6 +181,16 @@ def main(argv=None) -> int:
     name_w = max([len("variant")] + [len(v) for v, _ in ordered])
     print(f"{recorded.num_cycles} recorded cycles, {len(rows)} variants "
           f"({recorded.dir}); ewma gain {args.ewma_gain}")
+    if profile is not None:
+        breakdown = " + ".join(
+            f"{name} {ms:.1f}"
+            for name, ms in profile["mean_phase_ms"].items()
+        )
+        print(
+            f"recorded profile ({profile['cycles_profiled']} cycles): "
+            f"mean cycle {profile['mean_cycle_ms']:.1f} ms"
+            + (f" = {breakdown}" if breakdown else "")
+        )
     print(
         f"{'variant'.ljust(name_w)}  {'cycles':>6}  {'mean_rpm':>9}  "
         f"{'att_ttft':>8}  {'att_itl':>8}  {'err_ttft_ms':>11}  "

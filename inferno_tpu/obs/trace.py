@@ -35,6 +35,12 @@ class Span:
     name: str
     start_ms: float = 0.0
     duration_ms: float = 0.0
+    # process CPU milliseconds consumed while the span was open (all
+    # threads — a collect phase with pool workers can exceed its wall
+    # time, which is itself a finding). None unless the owning Tracer
+    # was created with cpu=True (the cycle profiler's mode, ISSUE-12);
+    # the default trace stays byte-identical to the pre-profiler format.
+    cpu_ms: float | None = None
     attrs: dict[str, Any] = dataclasses.field(default_factory=dict)
     children: list["Span"] = dataclasses.field(default_factory=list)
 
@@ -61,6 +67,8 @@ class Span:
             "start_ms": round(self.start_ms, 3),
             "duration_ms": round(self.duration_ms, 3),
         }
+        if self.cpu_ms is not None:
+            out["cpu_ms"] = round(self.cpu_ms, 3)
         if self.attrs:
             out["attrs"] = self.attrs
         if self.children:
@@ -82,9 +90,14 @@ class Tracer:
     traced operation can call it safely.
     """
 
-    def __init__(self, name: str = "trace"):
+    def __init__(self, name: str = "trace", cpu: bool = False):
         self.started_at = time.time()  # wall clock, operator display only
         self._t0 = time.perf_counter()
+        # cpu=True (the cycle profiler's mode) additionally stamps each
+        # span's process-CPU milliseconds; off by default so plain traces
+        # pay nothing and serialize exactly as before
+        self._cpu = cpu
+        self._c0 = time.process_time() if cpu else 0.0
         self.root = Span(name=name)
         self._stack: list[Span] = [self.root]
         self._finished = False
@@ -95,17 +108,28 @@ class Tracer:
     @contextlib.contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[Span]:
         sp = Span(name=name, start_ms=self._now_ms(), attrs=dict(attrs))
+        # CPU time only for TOP-LEVEL phases: they are what the profile
+        # document attributes (obs/profiler.py reads root children), and
+        # per-variant child spans — hundreds per cycle on a large fleet —
+        # must not each pay two process-clock reads for a value nothing
+        # consumes
+        track_cpu = self._cpu and len(self._stack) == 1
+        c0 = time.process_time() if track_cpu else 0.0
         self._stack[-1].children.append(sp)
         self._stack.append(sp)
         try:
             yield sp
         finally:
             sp.duration_ms = self._now_ms() - sp.start_ms
+            if track_cpu:
+                sp.cpu_ms = (time.process_time() - c0) * 1000.0
             self._stack.pop()
 
     def finish(self) -> Span:
         if not self._finished:
             self.root.duration_ms = self._now_ms()
+            if self._cpu:
+                self.root.cpu_ms = (time.process_time() - self._c0) * 1000.0
             self._finished = True
         return self.root
 

@@ -19,8 +19,15 @@ live calibration flows through the batched path with no interface change
 from __future__ import annotations
 
 import dataclasses
+import time
+
 import jax
 import numpy as np
+
+# cycle-profiler hooks (obs/profiler.py, ISSUE-12): each call is a
+# thread-local read when no profiler is active, two dict ops when one is.
+# Observation only — nothing in this module ever reads a counter back.
+from inferno_tpu.obs import profiler as _prof
 
 from inferno_tpu.core.allocation import (
     Allocation,
@@ -179,8 +186,15 @@ _plan_memo: dict[str, tuple[tuple, object]] = {}
 def _memoized_plan(kind: str, key: tuple, build):
     cached = _plan_memo.get(kind)
     if cached is not None and cached[0] == key:
+        _prof.count("plan_memo_hits")
         return cached[1]
+    _prof.count("plan_memo_misses")
+    t0 = time.perf_counter()
     plan = build()
+    # "repack" attribution: the full lane-set rebuild the memo exists to
+    # avoid — rows/columns/meta extraction on the snapshot path, the
+    # per-lane Python walk on the legacy path
+    _prof.add_ms("plan_repack_ms", (time.perf_counter() - t0) * 1000.0)
     _plan_memo[kind] = (key, plan)
     return plan
 
@@ -210,7 +224,11 @@ def _snapshot_plan(system: System, only: set[str] | None, kind: str):
     numpy, with an O(1) version-keyed memo — replaces the per-lane
     Python walk of the legacy builders below."""
     snap = _get_snapshot()
+    t0 = time.perf_counter()
     version = snap.update(system)
+    # snapshot re-derivation: the O(servers) change-detection walk +
+    # column refresh of changed servers (vs the O(1) memo replay above)
+    _prof.add_ms("snapshot_update_ms", (time.perf_counter() - t0) * 1000.0)
     key = (version, None if only is None else frozenset(only))
 
     def build():
@@ -356,6 +374,12 @@ def build_tandem_fleet(system: System, only: set[str] | None = None) -> TandemPl
 
 
 _fn_cache: dict[tuple[tuple[tuple[str, int], ...], int, bool], object] = {}
+# (program key, argument shapes) signatures already dispatched at least
+# once — the jit compile-vs-execute attribution boundary (see _solve_all).
+# Deliberately NOT cleared by reset_fleet_state: the jitted programs in
+# _fn_cache survive it too, so a re-dispatch after a state reset is an
+# execute, not a compile.
+_compiled_sigs: set[tuple] = set()
 
 
 def _bucket_k(batch: int) -> int:
@@ -501,9 +525,33 @@ def _solve_all(
     if not subs:
         return agg_out, tan_out
 
-    packed_all = np.asarray(
-        jax.device_get(_jitted_multi(tuple(specs), n_iters, use_pallas)(*subs))
+    fn = _jitted_multi(tuple(specs), n_iters, use_pallas)
+    # compile-vs-execute attribution: jax compiles lazily on the first
+    # call per argument-shape signature, so a first-seen (program, lane
+    # shapes) call is charged to jit_compile_ms (compile-inclusive — the
+    # one execute riding it is noise next to tracing+XLA) and every
+    # replay to jit_execute_ms. The seen-set is maintained even with no
+    # profiler active so a profiler attached mid-process never
+    # misattributes warm programs as compiles.
+    sig = (
+        tuple(specs), n_iters, use_pallas,
+        tuple(s[0].shape for s in subs),
     )
+    first_compile = sig not in _compiled_sigs
+    t0 = time.perf_counter()
+    packed_all = np.asarray(jax.device_get(fn(*subs)))
+    solve_ms = (time.perf_counter() - t0) * 1000.0
+    # marked compiled only AFTER a successful dispatch: a first dispatch
+    # that raised (compile OOM, interrupt) never finished compiling, and
+    # the retry that actually pays the compile must not be charged to
+    # jit_execute_ms
+    _compiled_sigs.add(sig)
+    _prof.count("jit_dispatches")
+    if first_compile:
+        _prof.count("jit_compiles")
+        _prof.add_ms("jit_compile_ms", solve_ms)
+    else:
+        _prof.add_ms("jit_execute_ms", solve_ms)
     offset = 0
     for kind, idx, width in slots:
         res = unpack_result(packed_all[:, offset : offset + width])
@@ -564,7 +612,9 @@ def _solve_or_replay(
         and memo["plan"] is plan
         and memo["tandem"] is tandem
     ):
+        _prof.count("solve_memo_hits")
         return memo["results"]
+    _prof.count("solve_memo_misses")
     if backend == "native":
         # the C++ solver covers both lane kinds: no device runtime
         # and no XLA compilation on this path (jax stays a host-only

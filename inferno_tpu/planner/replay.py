@@ -46,6 +46,8 @@ pinned in tests and asserted by `make bench-recorder`).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from inferno_tpu.parallel.fleet import FleetBatchResult, calculate_fleet_batch
@@ -336,9 +338,16 @@ def replay_recorded(
     """Replay a recorded artifact against `system` (the current fleet
     snapshot): same report shape as a synthetic scenario — per-pool /
     per-quota demand, first binds, cost bands, optional forecast-bound
-    pass over the real history — plus the variant-drift block."""
+    pass over the real history — plus the variant-drift block and a
+    ``profile`` block attributing the replay's own wall time (rate-matrix
+    join + solve + aggregation; ISSUE-12). When the recorded cycles
+    carry their own profile column, the recording's aggregate cost
+    attribution rides along as ``recorded_profile`` — the live
+    controller's cost next to the replay's."""
     names = list(system.servers)
+    t0 = time.perf_counter()
     rates, drift = recorded_rates(recorded, names, rate_field)
+    rates_ms = round((time.perf_counter() - t0) * 1000.0, 1)
     trace = ScenarioTrace(
         name="recorded",
         rates=rates,
@@ -357,6 +366,10 @@ def replay_recorded(
     )
     out["drift"] = drift
     out["source"] = "recorded"
+    out["profile"] = {"rates_ms": rates_ms, **out.get("profile", {})}
+    recorded_profile = recorded.profile_summary()
+    if recorded_profile is not None:
+        out["recorded_profile"] = recorded_profile
     return out
 
 
@@ -445,10 +458,23 @@ def replay_scenario(
     forecast_config=None,
 ) -> dict:
     """Replay one scenario through the batched solve; optionally a second
-    forecast-bound pass for the reactive-vs-forecast comparison."""
+    forecast-bound pass for the reactive-vs-forecast comparison.
+
+    The report carries a ``profile`` block attributing where the replay's
+    own wall time went (ISSUE-12): the batched solve vs the numpy
+    aggregation vs the optional forecast passes — so a slow planner run
+    is diagnosable from its report instead of re-run under a stopwatch."""
+    profile: dict[str, float] = {}
+    t0 = time.perf_counter()
     result = calculate_fleet_batch(
         system, trace.rates, backend=backend, chunk_steps=chunk_steps
     )
+    profile["solve_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+    t0 = time.perf_counter()
+    reactive = aggregate_replay(
+        system, result, trace.step_seconds, include_series
+    )
+    profile["aggregate_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
     out = {
         "scenario": trace.name,
         "description": trace.description,
@@ -456,22 +482,33 @@ def replay_scenario(
         "steps": trace.steps,
         "step_seconds": trace.step_seconds,
         "variants": len(result.servers),
-        "reactive": aggregate_replay(
-            system, result, trace.step_seconds, include_series
-        ),
+        "reactive": reactive,
     }
     if forecast:
         horizon = (
             trace.step_seconds if forecast_horizon_s is None else forecast_horizon_s
         )
+        t0 = time.perf_counter()
         eff = forecast_bound_rates(
             trace.rates, trace.step_seconds, horizon, forecast_config
         )
+        profile["forecast_filter_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 1
+        )
+        t0 = time.perf_counter()
         bound = calculate_fleet_batch(
             system, eff, backend=backend, chunk_steps=chunk_steps
         )
+        profile["forecast_solve_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 1
+        )
         out["forecast_horizon_s"] = horizon
+        t0 = time.perf_counter()
         out["forecast_bound"] = aggregate_replay(
             system, bound, trace.step_seconds, include_series
         )
+        profile["forecast_aggregate_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 1
+        )
+    out["profile"] = profile
     return out

@@ -37,6 +37,9 @@ import os
 
 import numpy as np
 
+# cycle-profiler hooks (obs/profiler.py, ISSUE-12): thread-local no-ops
+# unless a profiler is active; observation only
+from inferno_tpu.obs import profiler as _prof
 from inferno_tpu.config.defaults import (
     DEFAULT_SERVICE_CLASS_PRIORITY,
     SaturationPolicy,
@@ -495,6 +498,7 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
                     servers_list[pos].set_allocation(
                         materialize(int(e_start_a[e]), pos)
                     )
+                _prof.count("ledger_bulk_groups")
                 return []
 
         # exact sequential loop: heap keys replicate the scalar solver's
@@ -504,9 +508,12 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
             (int(e_prio[e]), -float(delta0[e]), -float(value0[e]), k, int(e))
             for k, e in enumerate(group)
         ]
+        _prof.count("ledger_heap_groups")
+        heap_pops = 0
         reinsert_seq = -1
         unallocated: list[int] = []
         while heap:
+            heap_pops += 1
             _, _, _, _, e = heapq.heappop(heap)
             pos = int(e_pos_a[e])
             row = int(e_start_a[e] + cur[e])
@@ -573,6 +580,9 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
                      reinsert_seq, e),
                 )
                 reinsert_seq -= 1
+        # one batched count, not one hook call per pop: the heap walk is
+        # the solver's hot path when a pool binds
+        _prof.count("ledger_heap_pops", heap_pops)
         return unallocated
 
     def settle(unallocated: list[int]) -> None:

@@ -13,9 +13,11 @@ def test_production_catalog_is_clean():
     # three predictive-scaling forecast gauges, the three fleet-scale
     # cycle instruments (query counter, cache-lookup gauge,
     # collect-concurrency histogram), the flight-recorder drop counter,
-    # the four attainment/model-error scoreboard gauges, and the three
-    # spot-market series (placement gauges + preemption counter)
-    assert len(names) == 22
+    # the four attainment/model-error scoreboard gauges, the three
+    # spot-market series (placement gauges + preemption counter), and
+    # the six cycle-profiler series (phase wall/CPU histograms, burn
+    # gauge, event + ms counters, memory high-water gauge)
+    assert len(names) == 28
     assert {"inferno_desired_replicas", "inferno_cycle_duration_seconds",
             "inferno_variant_analysis_seconds", "inferno_solver_seconds",
             "inferno_prom_scrape_seconds"} <= names
@@ -104,6 +106,65 @@ def test_lint_enforces_unit_suffix_with_allowlist():
         "inferno_desired_replicas", "inferno_current_replicas",
         "inferno_sizing_cache_lookups", "inferno_collect_concurrency",
     }
+
+
+def test_profiler_series_in_catalog():
+    """The ISSUE-12 cycle-profiler series ride the same prefix + help
+    enforcement and register unconditionally (the catalog must not
+    depend on whether CYCLE_PROFILER is on)."""
+    registry = build_controller_registry()
+    catalog = {name: (help_, kind) for name, help_, kind in registry.catalog()}
+    expected = {
+        "inferno_profile_phase_seconds": "histogram",
+        "inferno_profile_phase_cpu_seconds": "histogram",
+        "inferno_profile_budget_burn_ratio": "gauge",
+        "inferno_profile_events_total": "counter",
+        "inferno_profile_counter_ms": "counter",
+        "inferno_profile_mem_peak_bytes": "gauge",
+    }
+    for name, kind in expected.items():
+        assert name in catalog, name
+        help_, got_kind = catalog[name]
+        assert got_kind == kind
+        assert help_.strip()
+
+
+def test_lint_flags_bad_histogram_buckets():
+    """ISSUE-12 satellite: bucket boundaries must be strictly increasing
+    and finite. The registry constructor only rejects unsorted tuples —
+    duplicates and infinities pass it and silently corrupt the rendered
+    cumulative counts, which is exactly what the lint exists to catch."""
+    registry = Registry()
+    registry.histogram("inferno_dup_seconds", "help", buckets=(0.1, 0.1, 1.0))
+    registry.histogram(
+        "inferno_inf_seconds", "help", buckets=(0.1, 1.0, float("inf"))
+    )
+    registry.histogram("inferno_ok_seconds", "help", buckets=(0.1, 1.0))
+    violations = lint_registry(registry)
+    assert len(violations) == 2
+    assert any(
+        "inferno_dup_seconds" in v and "strictly increasing" in v
+        for v in violations
+    )
+    assert any(
+        "inferno_inf_seconds" in v and "non-finite" in v for v in violations
+    )
+    assert not any("inferno_ok_seconds" in v for v in violations)
+
+
+def test_every_production_histogram_has_sane_buckets():
+    """The bucket rule runs over EVERY histogram the controller
+    registers — the registry exposes them via `histograms()`, so a new
+    instrument with a silently unsorted bucket list fails here and in
+    `make lint-metrics`."""
+    registry = build_controller_registry()
+    hists = dict(registry.histograms())
+    assert "inferno_profile_phase_seconds" in hists
+    assert "inferno_cycle_duration_seconds" in hists
+    for name, buckets in hists.items():
+        assert buckets, name
+        assert all(b2 > b1 for b1, b2 in zip(buckets, buckets[1:])), name
+    assert lint_registry(registry) == []
 
 
 def test_lint_cli_exit_code():

@@ -118,6 +118,74 @@ def test_histogram_series_survive_gauge_pruning():
     assert "inferno_cycle_duration_seconds_count 1" in "\n".join(lines)
 
 
+def _fetch_json(port, cafile, path):
+    ctx = ssl.create_default_context(cafile=cafile)
+    import json
+
+    with urllib.request.urlopen(
+        f"https://localhost:{port}{path}", context=ctx, timeout=10
+    ) as resp:
+        return json.load(resp)
+
+
+def test_debug_routes_served_over_tls(tmp_path):
+    """ISSUE-12 satellite: /debug/profile and /debug/attainment ride the
+    same TLS listener as /metrics and /debug/decisions — filters, 400s,
+    and payload shape intact through the wrapped socket."""
+    import json
+
+    from inferno_tpu.obs import TraceBuffer
+    from inferno_tpu.obs.attainment import AttainmentTracker
+    from inferno_tpu.obs.profiler import PROFILE_SCHEMA
+
+    cert, key = make_cert(tmp_path, "srv")
+    profiles = TraceBuffer(capacity=4)
+    for i in range(3):
+        profiles.append({
+            "schema": PROFILE_SCHEMA,
+            "cycle": {"wall_ms": 100.0 + i},
+            "phases": {"solve": {"wall_ms": 10.0 + i, "cpu_ms": 9.0}},
+            "counters": {"jit_dispatches": 1},
+        })
+    attainment = AttainmentTracker()
+    attainment.observe("v:ns", predicted_ttft_ms=10.0, predicted_itl_ms=5.0,
+                       observed_ttft_ms=12.0, observed_itl_ms=6.0,
+                       slo_ttft_ms=100.0, slo_itl_ms=20.0)
+    traces = TraceBuffer(capacity=4)
+    traces.append({"decisions": []})
+    server = MetricsServer(
+        Registry(), port=0, tls=TLSConfig(cert, key),
+        traces=traces, attainment=attainment, profiles=profiles,
+    )
+    server.start()
+    try:
+        doc = _fetch_json(server.port, cert, "/debug/profile?cycles=2")
+        assert len(doc["cycles"]) == 2
+        assert doc["cycles"][-1]["phases"]["solve"]["wall_ms"] == 12.0
+
+        doc = _fetch_json(server.port, cert, "/debug/profile?phase=solve")
+        assert all("counters" not in c for c in doc["cycles"])
+
+        doc = _fetch_json(server.port, cert, "/debug/attainment?variant=v:ns")
+        assert set(doc["variants"]) == {"v:ns"}
+
+        doc = _fetch_json(server.port, cert, "/debug/decisions")
+        assert len(doc["cycles"]) == 1
+
+        # the 400 contract holds through TLS on both new routes
+        ctx = ssl.create_default_context(cafile=cert)
+        for path in ("/debug/profile?cycles=0", "/debug/attainment?bad=1"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"https://localhost:{server.port}{path}",
+                    context=ctx, timeout=10,
+                )
+            assert exc.value.code == 400, path
+            assert "error" in json.load(exc.value)
+    finally:
+        server.stop()
+
+
 def test_plain_http_rejected(tls_server):
     server, *_ = tls_server
     with pytest.raises(Exception):

@@ -728,6 +728,71 @@ def test_debug_attainment_endpoint():
         server.stop()
 
 
+def test_debug_attainment_variant_filter_and_400_contract():
+    """ISSUE-12 satellite: /debug/attainment gains ?variant= with the
+    same 400-on-malformed contract /debug/decisions got in PR 10 — the
+    two routes share one query-param validation helper."""
+    import copy
+
+    cluster = make_cluster(replicas=1)
+    va = cluster.get_variant_autoscaling(NS, "llama-premium")
+    va2 = copy.deepcopy(va)
+    va2.name = "llama-second"
+    cluster.add_variant_autoscaling(va2)
+    cluster.add_deployment(NS, "llama-second", replicas=1)
+    rec = reconciler(cluster, make_prom(arrival_rps=50.0))
+    server = MetricsServer(
+        rec.emitter.registry, port=0, attainment=rec.attainment
+    )
+    server.start()
+    try:
+        rec.run_cycle()
+        rec.run_cycle()
+        base = f"http://127.0.0.1:{server.port}/debug/attainment"
+
+        doc = _get_json(base)
+        assert set(doc["variants"]) == {
+            "llama-premium:workloads", "llama-second:workloads"
+        }
+
+        doc = _get_json(base + "?variant=llama-second:workloads")
+        assert set(doc["variants"]) == {"llama-second:workloads"}
+        assert doc["ewma_gain"] == pytest.approx(0.2)  # envelope intact
+
+        # an unknown variant: empty map, mirroring the decisions route's
+        # never-reported-variant semantics (not a 404)
+        doc = _get_json(base + "?variant=nope:ns")
+        assert doc["variants"] == {}
+
+        for bad in ("?variant=", "?foo=1", "?cycles=2"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + bad, timeout=10)
+            assert exc.value.code == 400, bad
+            assert "error" in json.load(exc.value)
+    finally:
+        server.stop()
+
+
+def test_debug_query_helper_shared_by_routes():
+    """The validation contract itself (metrics.parse_debug_query): one
+    helper serves decisions, attainment, and profile."""
+    from inferno_tpu.controller.metrics import _QueryError, parse_debug_query
+
+    assert parse_debug_query(
+        {"variant": "v", "cycles": "3"},
+        str_params={"variant"}, int_params={"cycles"},
+    ) == {"variant": "v", "cycles": 3}
+    assert parse_debug_query(None, str_params={"variant"}) == {}
+    with pytest.raises(_QueryError, match="unknown parameter"):
+        parse_debug_query({"nope": "1"}, str_params={"variant"})
+    with pytest.raises(_QueryError, match="non-empty"):
+        parse_debug_query({"variant": ""}, str_params={"variant"})
+    with pytest.raises(_QueryError, match="integer"):
+        parse_debug_query({"cycles": "abc"}, int_params={"cycles"})
+    with pytest.raises(_QueryError, match=">= 1"):
+        parse_debug_query({"cycles": "0"}, int_params={"cycles"})
+
+
 # -- stale-controller readiness ----------------------------------------------
 
 

@@ -1,0 +1,466 @@
+"""Cycle profiler + perf-regression sentinel (ISSUE-12): typed
+counters, per-cycle profile documents, default-on reconciler wiring with
+bit-identical-decisions parity, fleet/ledger instrumentation sites, the
+/debug/profile route, and perfdiff verdicts incl. the 2x-injected
+regression the CI gate must catch."""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from inferno_tpu.controller import Reconciler, ReconcilerConfig
+from inferno_tpu.controller.metrics import (
+    MetricsServer,
+    ProfilerInstruments,
+    Registry,
+)
+from inferno_tpu.obs import PROFILE_SCHEMA, CycleProfiler, Tracer, build_profile_doc
+from inferno_tpu.obs import perfdiff
+from inferno_tpu.obs import profiler as prof_mod
+
+from test_controller import CFG_NS, NS, make_cluster, make_prom
+
+
+def reconciler(cluster, prom, **kw):
+    cfg = ReconcilerConfig(config_namespace=CFG_NS, compute_backend="scalar", **kw)
+    return Reconciler(kube=cluster, prom=prom, config=cfg)
+
+
+# -- profiler primitives -----------------------------------------------------
+
+
+def test_module_hooks_are_noops_without_active_profiler():
+    assert prof_mod.current() is None
+    prof_mod.count("anything")
+    prof_mod.add_ms("anything_ms", 1.0)
+    assert prof_mod.current() is None
+
+
+def test_profiler_counters_typed_by_suffix():
+    with CycleProfiler() as p:
+        assert prof_mod.current() is p
+        prof_mod.count("jit_dispatches")
+        prof_mod.count("jit_dispatches", 2)
+        prof_mod.add_ms("solve_ms", 1.25)
+        prof_mod.add_ms("solve_ms", 0.75)
+    assert prof_mod.current() is None
+    assert p.counters == {"jit_dispatches": 3, "solve_ms": 2.0}
+    # deactivated: hooks no longer reach it
+    prof_mod.count("jit_dispatches")
+    assert p.counters["jit_dispatches"] == 3
+
+
+def test_profiler_is_thread_local():
+    import threading
+
+    with CycleProfiler() as p:
+        seen = []
+
+        def worker():
+            seen.append(prof_mod.current())
+            prof_mod.count("worker_events")
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen == [None]  # the pool-worker thread sees no profiler
+    assert "worker_events" not in p.counters
+
+
+def test_build_profile_doc_merges_phases_and_carries_cpu():
+    tracer = Tracer("reconcile-cycle", cpu=True)
+    with tracer.span("collect"):
+        pass
+    with tracer.span("solve"):
+        sum(range(20000))
+    with tracer.span("solve"):  # repeated phase name merges
+        pass
+    root = tracer.finish()
+    with CycleProfiler() as p:
+        prof_mod.add_ms("jit_execute_ms", 3.0)
+        prof_mod.count("plan_memo_hits")
+    doc = build_profile_doc(root, p, started_at="2026-08-04T00:00:00Z",
+                            interval_seconds=60)
+    assert doc["schema"] == PROFILE_SCHEMA
+    assert set(doc["phases"]) == {"collect", "solve"}
+    assert doc["cycle"]["wall_ms"] >= doc["phases"]["solve"]["wall_ms"]
+    for entry in doc["phases"].values():
+        assert entry["wall_ms"] >= 0.0
+        assert entry["cpu_ms"] >= 0.0
+    assert doc["counters"] == {"jit_execute_ms": 3.0, "plan_memo_hits": 1}
+    # JSON-ready end to end
+    assert json.loads(json.dumps(doc)) == doc
+
+
+def test_plain_tracer_document_unchanged():
+    """cpu=False (the default) must serialize exactly the pre-profiler
+    span shape — no cpu_ms key anywhere."""
+    tracer = Tracer("t")
+    with tracer.span("a"):
+        pass
+    doc = tracer.finish().to_dict()
+    assert "cpu_ms" not in doc
+    assert "cpu_ms" not in doc["children"][0]
+
+
+# -- reconciler wiring -------------------------------------------------------
+
+
+def test_reconciler_profiles_cycles_by_default():
+    rec = reconciler(make_cluster(replicas=1), make_prom(arrival_rps=50.0))
+    report = rec.run_cycle()
+    doc = report.profile
+    assert doc is not None and doc["schema"] == PROFILE_SCHEMA
+    assert {"collect", "analyze", "solve", "actuate"} <= set(doc["phases"])
+    for entry in doc["phases"].values():
+        assert entry["wall_ms"] >= 0.0
+        assert "cpu_ms" in entry
+    assert doc["counters"]["prom_queries"] == report.prom_queries
+    # the profile ring retains the document for /debug/profile
+    snap = rec.profiles.snapshot()
+    assert len(snap) == 1 and snap[0]["phases"] == doc["phases"]
+    # and the Prometheus surface renders the series
+    body = rec.emitter.registry.render()
+    assert 'inferno_profile_phase_seconds_bucket{le="+Inf",phase="solve"}' in body
+    assert 'inferno_profile_budget_burn_ratio{phase="collect"}' in body
+    assert "inferno_profile_events_total" in body
+
+
+def test_profiler_off_decisions_bit_identical():
+    """CYCLE_PROFILER=false cycles decide exactly what profiled cycles
+    decide — profiling is observation-only (the parity half of the
+    bench-profile contract)."""
+    reports = {}
+    for on in (True, False):
+        rec = reconciler(
+            make_cluster(replicas=1), make_prom(arrival_rps=50.0),
+            cycle_profiler=on,
+        )
+        reports[on] = [rec.run_cycle(), rec.run_cycle()]
+    assert reports[False][0].profile is None
+    assert reports[True][0].profile is not None
+    for r_on, r_off in zip(reports[True], reports[False]):
+        assert [d.to_dict() for d in r_on.decisions] == [
+            d.to_dict() for d in r_off.decisions
+        ]
+    # the profiler-off reconciler retained no profile documents
+    rec_off = reconciler(
+        make_cluster(replicas=1), make_prom(arrival_rps=50.0),
+        cycle_profiler=False,
+    )
+    rec_off.run_cycle()
+    assert len(rec_off.profiles) == 0
+
+
+def test_sizing_cache_counts_fold_into_profile():
+    rec = reconciler(
+        make_cluster(replicas=1), make_prom(arrival_rps=50.0),
+        sizing_cache=True, sizing_cache_tolerance=0.5,
+    )
+    rec.run_cycle()
+    report = rec.run_cycle()  # unchanged inputs: cache replays
+    assert report.profile["counters"]["sizing_cache_hits"] == \
+        report.sizing_cache_hits
+    assert report.sizing_cache_hits >= 1
+
+
+# -- instrumentation sites (parallel/fleet.py, solver/greedy_vec.py) ---------
+
+
+@pytest.fixture()
+def _fresh_fleet_state():
+    from inferno_tpu.parallel import reset_fleet_state
+
+    reset_fleet_state()
+    yield
+    reset_fleet_state()
+
+
+def test_fleet_counters_attribute_memos_and_jit(_fresh_fleet_state):
+    from inferno_tpu.core import System
+    from inferno_tpu.parallel import calculate_fleet
+    from inferno_tpu.testing.fleet import fleet_system_spec
+
+    spec = fleet_system_spec(8)
+    system = System(spec)
+    with CycleProfiler() as p1:
+        calculate_fleet(system, backend="jax")
+    # fresh state: the plan was built (memo miss) and one fused program
+    # dispatched; its wall time is attributed to exactly one jit bucket
+    assert p1.counters["plan_memo_misses"] >= 1
+    assert p1.counters.get("plan_memo_hits", 0) == 0
+    assert p1.counters["jit_dispatches"] == 1
+    jit_ms = (p1.counters.get("jit_compile_ms", 0.0)
+              + p1.counters.get("jit_execute_ms", 0.0))
+    assert jit_ms > 0.0
+    assert p1.counters["plan_repack_ms"] > 0.0
+    assert p1.counters["snapshot_update_ms"] > 0.0
+
+    with CycleProfiler() as p2:
+        calculate_fleet(system, backend="jax")
+    # unchanged fleet: plan memo replays, solve memo skips the dispatch
+    assert p2.counters["plan_memo_hits"] >= 1
+    assert p2.counters["solve_memo_hits"] == 1
+    assert "jit_dispatches" not in p2.counters
+
+
+def test_ledger_counters_split_bulk_vs_heap(_fresh_fleet_state):
+    from inferno_tpu.config.types import CapacitySpec
+    from inferno_tpu.core import System
+    from inferno_tpu.parallel import calculate_fleet
+    from inferno_tpu.solver.greedy_vec import solve_greedy_fleet
+    from inferno_tpu.testing.fleet import fleet_capacity, fleet_system_spec
+
+    spec = fleet_system_spec(12, priority_classes=2)
+    loose = dataclasses.replace(
+        spec, capacity=CapacitySpec(chips=fleet_capacity(spec, 10.0))
+    )
+    system = System(loose)
+    calculate_fleet(system, backend="jax")
+    with CycleProfiler() as p:
+        solve_greedy_fleet(system, loose.optimizer)
+    # everything fits: every priority group takes the bulk path
+    assert p.counters["ledger_bulk_groups"] >= 1
+    assert p.counters.get("ledger_heap_groups", 0) == 0
+
+    tight = dataclasses.replace(
+        spec, capacity=CapacitySpec(chips=fleet_capacity(spec, 0.4))
+    )
+    system = System(tight)
+    calculate_fleet(system, backend="jax")
+    with CycleProfiler() as p:
+        solve_greedy_fleet(system, tight.optimizer)
+    # a binding pool forces at least one group onto the exact heap walk
+    assert p.counters["ledger_heap_groups"] >= 1
+    assert p.counters["ledger_heap_pops"] >= 1
+
+
+# -- /debug/profile ----------------------------------------------------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.load(resp)
+
+
+def test_debug_profile_route_serves_last_k_cycles():
+    rec = reconciler(make_cluster(replicas=1), make_prom(arrival_rps=50.0))
+    server = MetricsServer(rec.emitter.registry, port=0, profiles=rec.profiles)
+    server.start()
+    try:
+        for _ in range(3):
+            rec.run_cycle()
+        base = f"http://127.0.0.1:{server.port}/debug/profile"
+        doc = _get_json(base)
+        assert doc["capacity"] == rec.profiles.capacity
+        assert len(doc["cycles"]) == 3
+        latest = doc["cycles"][-1]
+        assert latest["schema"] == PROFILE_SCHEMA
+        assert {"collect", "analyze", "solve", "actuate"} <= set(latest["phases"])
+        assert latest["phases"]["solve"]["wall_ms"] >= 0.0
+        assert "cpu_ms" in latest["phases"]["solve"]
+        assert "prom_queries" in latest["counters"]
+
+        doc = _get_json(base + "?cycles=1")
+        assert len(doc["cycles"]) == 1
+        assert doc["cycles"][0]["seq"] == 3
+
+        doc = _get_json(base + "?phase=solve&cycles=2")
+        assert len(doc["cycles"]) == 2
+        for cyc in doc["cycles"]:
+            assert set(cyc["phases"]) == {"solve"}
+            # fleet-wide counters omitted from filtered views (mirrors
+            # the decisions route omitting the span tree)
+            assert "counters" not in cyc
+            assert "seq" in cyc
+
+        # a phase that never ran: cycles kept, phases empty
+        doc = _get_json(base + "?phase=nope")
+        assert all(cyc["phases"] == {} for cyc in doc["cycles"])
+
+        for bad in ("?cycles=abc", "?cycles=0", "?foo=1", "?phase="):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + bad, timeout=10)
+            assert exc.value.code == 400, bad
+            assert "error" in json.load(exc.value)
+
+        # without a buffer the route does not exist
+        bare = MetricsServer(Registry(), port=0)
+        bare.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{bare.port}/debug/profile", timeout=10
+                )
+            assert exc.value.code == 404
+        finally:
+            bare.stop()
+    finally:
+        server.stop()
+
+
+def test_profiler_instruments_prune_stale_phase_burn():
+    inst = ProfilerInstruments(Registry())
+    doc = {"phases": {"collect": {"wall_ms": 500.0},
+                      "solve": {"wall_ms": 1500.0}},
+           "counters": {"jit_dispatches": 2, "jit_execute_ms": 12.5,
+                        "mem_py_peak_kb": 64.0}}
+    inst.observe_profile(doc, interval_seconds=60)
+    body = inst.registry.render()
+    assert 'inferno_profile_budget_burn_ratio{phase="solve"} 0.025' in body
+    assert 'inferno_profile_events_total{event="jit_dispatches"} 2' in body
+    assert 'inferno_profile_counter_ms{counter="jit_execute_ms"} 12.5' in body
+    assert "inferno_profile_mem_peak_bytes 65536" in body
+    # a later cycle without a solve phase prunes its burn gauge
+    inst.observe_profile({"phases": {"collect": {"wall_ms": 100.0}},
+                          "counters": {}}, interval_seconds=60)
+    body = inst.registry.render()
+    assert 'inferno_profile_budget_burn_ratio{phase="solve"}' not in body
+    assert 'inferno_profile_budget_burn_ratio{phase="collect"}' in body
+
+
+# -- perfdiff ----------------------------------------------------------------
+
+
+def _profile_cycle(wall, solve, jit_exec):
+    return {
+        "schema": PROFILE_SCHEMA,
+        "cycle": {"wall_ms": wall},
+        "phases": {"solve": {"wall_ms": solve}},
+        "counters": {"jit_execute_ms": jit_exec},
+    }
+
+
+def test_perfdiff_extracts_all_three_source_shapes():
+    bench_r = {"parsed": {"extra": {
+        "fleet_cycle_ms": 86.1, "sizing_10k_ms": 788.0,
+        "profile_overhead_pct": 0.2, "bench_rev": "r05",
+        "tpu_reachable": False,
+    }}}
+    m = perfdiff.extract_metrics(bench_r)
+    assert m["fleet_cycle_ms"]["value"] == 86.1
+    assert "bench_rev" not in m and "tpu_reachable" not in m
+
+    full = {
+        "profile": {"cycle_ms": 300.0, "cycle_ms_spread": 30.0,
+                    "cycle_jit_ms": 40.0, "profile_overhead_pct": 0.3,
+                    "overhead_budget_pct": 1.0,
+                    "phases": {"solve": {"wall_ms": 50.0}}},
+        "sizing": {"curve": [
+            {"n_variants": 200, "sizing_ms": 60.0, "sizing_ms_spread": 5.0},
+            {"n_variants": 10000, "sizing_ms": 788.0, "sizing_ms_spread": 40.0},
+        ]},
+        "capacity": {"points": [
+            {"fraction": 0.5, "solve_ms": 900.0, "solve_ms_spread": 10.0},
+        ]},
+        "planner": {"planner_week_ms": 2500.0},
+        "cycles": {"auto_selected_ms": 86.0},
+    }
+    m = perfdiff.extract_metrics(full)
+    assert m["cycle_ms"] == {"value": 300.0, "spread": 30.0}
+    assert m["phase_solve_ms"]["value"] == 50.0
+    assert m["sizing_10k_ms"]["value"] == 788.0
+    assert m["capacity_50pct_ms"]["value"] == 900.0
+    assert m["capacity_10k_ms"]["value"] == 900.0
+    assert m["planner_week_ms"]["value"] == 2500.0
+    assert m["fleet_cycle_ms"]["value"] == 86.0
+    assert "overhead_budget_pct" not in m  # config constant, not a metric
+
+    live = {"cycles": [_profile_cycle(100, 20, 10),
+                       _profile_cycle(120, 30, 14),
+                       _profile_cycle(110, 25, 12)]}
+    m = perfdiff.extract_metrics(live)
+    assert m["cycle_ms"] == {"value": 110.0, "spread": 20.0}
+    assert m["phase_solve_ms"]["value"] == 25.0
+    assert m["jit_execute_ms"]["value"] == 12.0
+    assert m["cycle_jit_ms"]["value"] == 12.0
+
+
+def test_perfdiff_passes_identical_and_fails_2x_injection():
+    base = perfdiff.extract_metrics({"cycles": [
+        _profile_cycle(100, 40, 10), _profile_cycle(104, 42, 11),
+    ]})
+    # identical inputs: zero regressions, every verdict ok
+    clean = perfdiff.compare(base, dict(base))
+    assert clean["regressions"] == []
+    assert all(r["verdict"] == "ok" for r in clean["rows"])
+    # synthetic 2x regression on the solve phase: caught and named
+    slow = perfdiff.extract_metrics({"cycles": [
+        _profile_cycle(160, 80, 10), _profile_cycle(164, 84, 11),
+    ]})
+    verdict = perfdiff.compare(base, slow)
+    assert "phase_solve_ms" in verdict["regressions"]
+    assert "cycle_ms" in verdict["regressions"]
+    assert "jit_execute_ms" not in verdict["regressions"]
+
+
+def test_perfdiff_noise_band_and_min_abs_floor():
+    base = {"solve_ms": perfdiff.Metric(100.0, 80.0)}  # very noisy repeats
+    cand = {"solve_ms": perfdiff.Metric(165.0, 10.0)}
+    # 1.65x sits inside the 90% repeat-noise band: not a regression
+    assert perfdiff.compare(base, cand)["regressions"] == []
+    # tiny metrics never regress below the absolute floor
+    base = {"tick_ms": perfdiff.Metric(1.0)}
+    cand = {"tick_ms": perfdiff.Metric(3.0)}
+    assert perfdiff.compare(base, cand)["regressions"] == []
+    cand = {"tick_ms": perfdiff.Metric(30.0)}
+    assert perfdiff.compare(base, cand)["regressions"] == ["tick_ms"]
+    # *_pct metrics use a percentage-point floor, not the ms floor: an
+    # overhead pct bounded near 1 must still be gateable
+    base = {"profile_overhead_pct": perfdiff.Metric(0.1)}
+    cand = {"profile_overhead_pct": perfdiff.Metric(0.9)}
+    assert perfdiff.compare(base, cand)["regressions"] == [
+        "profile_overhead_pct"
+    ]
+    cand = {"profile_overhead_pct": perfdiff.Metric(0.3)}  # under the floor
+    assert perfdiff.compare(base, cand)["regressions"] == []
+
+
+def test_perfdiff_gate_cli_exit_codes(tmp_path, capsys):
+    base = tmp_path / "BENCH_r07.json"
+    base.write_text(json.dumps({"parsed": {"extra": {
+        "fleet_cycle_ms": 86.0, "cycle_solve_ms": 40.0,
+    }}}))
+    good = tmp_path / "bench_full.json"
+    good.write_text(json.dumps({"profile": {
+        "fleet_cycle_ms": 90.0, "cycle_solve_ms": 41.0,
+    }}))
+    # clean tree: exit 0; 'auto' resolves the committed trajectory tip
+    assert perfdiff.main(["auto", str(good), "--gate"]) == 0
+    out = capsys.readouterr().out
+    assert "BENCH_r07.json" in out
+
+    bad = tmp_path / "bench_regressed.json"
+    bad.write_text(json.dumps({"profile": {
+        "fleet_cycle_ms": 86.0, "cycle_solve_ms": 80.0,  # injected 2x
+    }}))
+    assert perfdiff.main(["auto", str(bad), "--gate"]) == 2
+    err = capsys.readouterr().err
+    assert "REGRESSION in cycle_solve_ms" in err
+
+    # zero shared metrics under --gate: refuse to report a clean pass
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"profile": {}}))
+    assert perfdiff.main(["auto", str(empty), "--gate"]) == 1
+    # ...but without --gate a no-overlap diff is informational, exit 0
+    assert perfdiff.main(["auto", str(empty)]) == 0
+
+
+def test_perfdiff_auto_without_trajectory_errors(tmp_path):
+    cand = tmp_path / "bench_full.json"
+    cand.write_text("{}")
+    assert perfdiff.main(["auto", str(cand), "--gate"]) == 1
+
+
+# -- bench compact line ------------------------------------------------------
+
+
+def test_bench_revision_tag_scans_trajectory():
+    import bench
+
+    tag = bench.bench_revision_tag()
+    # the repo carries BENCH_r01..r05; a fresh run captures as r06+
+    assert tag.startswith("r") and int(tag[1:]) >= 6

@@ -129,6 +129,54 @@ def test_round_trip_through_reconciler(tmp_path):
     assert set(system.servers) == set(c.variants)
 
 
+def test_profile_column_round_trips_and_renders(tmp_path):
+    """ISSUE-12: a profiler-on controller records each cycle's profile
+    document in the artifact; the reader surfaces it per cycle and
+    aggregated (profile_summary), replay_recorded carries it next to the
+    replay's own cost attribution, and obs.report renders it. A
+    profiler-off recording (and any pre-profiler artifact) loads with
+    profile=None and no summary — the column is optional on read."""
+    from inferno_tpu.obs.profiler import PROFILE_SCHEMA
+    from inferno_tpu.planner.replay import replay_recorded, system_from_recorded
+
+    d = record_cycles(tmp_path, cycles=3)  # cycle_profiler defaults on
+    rt = read_artifact(d)
+    assert rt.warnings == []
+    for c in rt.cycles:
+        assert c.profile is not None
+        assert c.profile["schema"] == PROFILE_SCHEMA
+        assert {"collect", "analyze", "solve", "actuate"} <= set(
+            c.profile["phases"]
+        )
+        assert c.profile["phases"]["solve"]["wall_ms"] >= 0.0
+    summary = rt.profile_summary()
+    assert summary["cycles_profiled"] == 3
+    assert summary["mean_cycle_ms"] > 0.0
+    assert "solve" in summary["mean_phase_ms"]
+
+    # the replay report carries both cost attributions
+    out = replay_recorded(system_from_recorded(rt), rt, backend="jax")
+    assert out["profile"]["solve_ms"] >= 0.0
+    assert "rates_ms" in out["profile"] and "aggregate_ms" in out["profile"]
+    assert out["recorded_profile"]["cycles_profiled"] == 3
+
+    # obs.report renders the recorded profile line / JSON block
+    from inferno_tpu.obs.report import main as report_main
+
+    rc = report_main([d, "--no-replay", "--json"])
+    assert rc == 0
+
+    # profiler off: column absent, summary None, replay block absent
+    d_off = record_cycles(
+        tmp_path / "off", cycles=2, cycle_profiler=False
+    )
+    rt_off = read_artifact(d_off)
+    assert all(c.profile is None for c in rt_off.cycles)
+    assert rt_off.profile_summary() is None
+    out = replay_recorded(system_from_recorded(rt_off), rt_off, backend="jax")
+    assert "recorded_profile" not in out
+
+
 def test_record_replay_parity_bit_identical(tmp_path):
     """The acceptance pin: a recorded T=1 cycle replayed against its own
     fleet snapshot reproduces the live calculate_fleet decision exactly
