@@ -7,8 +7,9 @@ CLUSTER ?= inferno-tpu
 
 .PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
         bench-sizing bench-capacity bench-planner bench-recorder \
-        bench-spot bench-profile perf-gate native lint lint-metrics \
-        manifests-sync docker-build deploy-kind deploy undeploy clean
+        bench-spot bench-profile bench-incremental perf-gate native lint \
+        lint-metrics manifests-sync docker-build deploy-kind deploy \
+        undeploy clean
 
 all: native test
 
@@ -83,6 +84,14 @@ bench-spot:
 # attribution recorded in bench_full.json
 bench-profile:
 	$(PYTHON) bench.py --profile
+
+# Incremental dirty-set reconcile benchmark (ISSUE-13): 100k variants —
+# cold full solve within 5x the committed 10k sizing budget, 1%-dirty
+# steady-state cycle < 100 ms, incremental-vs-full bit-parity on the
+# decision surface; ALL asserted in the bench; recorded in
+# bench_full.json
+bench-incremental:
+	$(PYTHON) bench.py --incremental
 
 # Perf-regression gate (ISSUE-12, CI): run the fast bench points
 # (--quick --profile), then diff the freshly-measured candidate

@@ -61,6 +61,19 @@ SPOT_RISK_PENALTY_FACTOR = 1000.0
 # roughly the v5e multi-host pod-slice spin-up the catalog models.
 SPOT_RECOVERY_SECONDS = 180.0
 
+def rate_within_tolerance(anchor: float, observed: float, tolerance: float) -> bool:
+    """THE arrival-rate tolerance predicate, shared by the sizing cache
+    (controller/sizing_cache.py) and the incremental dirty scan
+    (parallel/snapshot.py): |observed - anchor| <= tolerance * max(anchor, 0).
+
+    One definition on purpose (ISSUE-13): a variant the cache would
+    replay as a hit must also count as *clean* for the fleet dirty set,
+    or the two skip layers would disagree about the same λ wiggle and a
+    `sizing_provenance: cached` decision could drift from a
+    skipped-server decision. Tolerance 0 means exact-λ only."""
+    return abs(observed - anchor) <= tolerance * max(anchor, 0.0)
+
+
 # Service class fallbacks (reference: pkg/config/defaults.go:24-33).
 DEFAULT_SERVICE_CLASS_NAME = "Free"
 DEFAULT_SERVICE_CLASS_PRIORITY = 100

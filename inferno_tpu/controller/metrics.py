@@ -68,6 +68,11 @@ class _Series:
     def remove(self, labels: dict[str, str]) -> None:
         self.values.pop(self._key(labels), None)
 
+    def labelsets(self) -> list[dict[str, str]]:
+        """Snapshot of the label sets with samples (pruning support —
+        same contract as _Histogram.labelsets)."""
+        return [dict(lbls) for lbls, _v in list(self.values.values())]
+
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} {self.kind}"
@@ -319,6 +324,10 @@ LABEL_RESULT = "result"
 # DROPPED because the writer thread (disk) could not keep up — the
 # recorder's explicit never-stall-a-cycle tradeoff made visible.
 METRIC_RECORDER_DROPPED = "inferno_recorder_dropped_total"
+# incremental dirty-set cycle (ISSUE-13, parallel/incremental.py)
+METRIC_DIRTY_LANES = "inferno_cycle_dirty_lanes_total"
+METRIC_SKIPPED_SERVERS = "inferno_cycle_skipped_servers_total"
+METRIC_DIRTY_RATIO = "inferno_cycle_dirty_ratio"
 
 # Collect-pool width buckets: powers of two up to the practical ceiling
 # of RECONCILE_CONCURRENCY (a thread per in-flight variant collect).
@@ -369,6 +378,24 @@ class CycleInstruments:
             "Reconcile cycles the flight recorder dropped because its "
             "bounded capture queue was full (slow disk)",
         )
+        # incremental dirty-set cycle (ISSUE-13): registered
+        # unconditionally like every instrument block; populated only
+        # when the incremental fleet path ran this cycle
+        self.dirty_lanes = self.registry.counter(
+            METRIC_DIRTY_LANES,
+            "Lanes re-solved through a sizing kernel by incremental "
+            "reconcile cycles (clean lanes replay and are not counted)",
+        )
+        self.skipped_servers = self.registry.counter(
+            METRIC_SKIPPED_SERVERS,
+            "Servers whose sizing, writeback, and allocation were "
+            "replayed untouched by incremental reconcile cycles",
+        )
+        self.dirty_ratio = self.registry.gauge(
+            METRIC_DIRTY_RATIO,
+            "Whether the variant was dirty (1) or replayed clean (0) in "
+            "the last incremental reconcile cycle",
+        )
 
     def observe_cycle(self, seconds: float) -> None:
         self.cycle.observe({}, seconds)
@@ -399,13 +426,33 @@ class CycleInstruments:
         if n > 0:
             self.recorder_dropped.inc({}, float(n))
 
+    def set_dirty_outcome(
+        self, dirty_lanes: int, skipped: int,
+        per_variant: list[tuple[str, str, bool]],
+    ) -> None:
+        """Publish one incremental cycle's dirty outcome: the fleet-wide
+        counters plus the per-variant dirty marker gauge."""
+        if dirty_lanes > 0:
+            self.dirty_lanes.inc({}, float(dirty_lanes))
+        if skipped > 0:
+            self.skipped_servers.inc({}, float(skipped))
+        for namespace, variant, dirty in per_variant:
+            self.dirty_ratio.set(
+                {LABEL_OUT_NAMESPACE: namespace, LABEL_VARIANT: variant},
+                1.0 if dirty else 0.0,
+            )
+
     def prune_variants(self, active: set[tuple[str, str]]) -> None:
-        """Drop per-variant analysis series of variants no longer managed
-        (same contract as MetricsEmitter.prune_variants)."""
-        for labels in self.analysis.labelsets():
-            key = (labels.get(LABEL_OUT_NAMESPACE, ""), labels.get(LABEL_VARIANT, ""))
-            if key not in active:
-                self.analysis.remove(labels)
+        """Drop per-variant analysis/dirty series of variants no longer
+        managed (same contract as MetricsEmitter.prune_variants)."""
+        for series in (self.analysis, self.dirty_ratio):
+            for labels in series.labelsets():
+                key = (
+                    labels.get(LABEL_OUT_NAMESPACE, ""),
+                    labels.get(LABEL_VARIANT, ""),
+                )
+                if key not in active:
+                    series.remove(labels)
 
 
 # Predictive-scaling forecast series (forecast/forecaster.py). All carry

@@ -1341,10 +1341,24 @@ class Reconciler:
                         # pipeline; "scalar" is the explicit parity oracle
                         from inferno_tpu.parallel import calculate_fleet
 
+                        # SIZING_CACHE and INCREMENTAL_CYCLE are
+                        # ALTERNATIVE skip layers: with the cache on,
+                        # sizing runs over the cache-miss subset
+                        # (`only=to_size`) and calculate_fleet routes
+                        # that through the full path — the incremental
+                        # cycle engages only with the cache off. The λ
+                        # tolerance semantics stay consistent either way
+                        # because both layers compare through ONE
+                        # predicate (config.defaults.
+                        # rate_within_tolerance, pinned in tests);
+                        # prefer INCREMENTAL_CYCLE at fleet scale — its
+                        # skip covers fold, writeback, and solve, not
+                        # just the sizing replay (docs/performance.md).
                         calculate_fleet(
                             system, backend=self.config.compute_backend,
                             only=to_size,
                         )
+                        self._publish_dirty(system)
                     else:
                         system.calculate_all(only=to_size)
                 else:
@@ -1388,6 +1402,23 @@ class Reconciler:
         with tracer.span("actuate") as sp:
             self._apply(prepared, solution, report, system)
             sp.set(variants_applied=report.variants_applied)
+
+    def _publish_dirty(self, system: System) -> None:
+        """Publish the incremental cycle's dirty outcome
+        (inferno_cycle_dirty_* — ISSUE-13). A cycle that ran the full
+        path (INCREMENTAL_CYCLE=0, sizing-cache subset, non-jitted
+        backend) carries no dirty info and publishes nothing."""
+        fd = getattr(system, "fleet_dirty", None)
+        if fd is None:
+            return
+        per_variant: list[tuple[str, str, bool]] = []
+        for pos, name in enumerate(system.servers):
+            # server key = VariantAutoscaling.full_name = "name:namespace"
+            short, _, ns = name.partition(":")
+            per_variant.append((ns, short, bool(fd.codes[pos])))
+        self.instruments.set_dirty_outcome(
+            fd.dirty_lanes, fd.skipped_servers, per_variant
+        )
 
     def _publish_spot(self, system: System) -> None:
         """Per-pool spot gauges from the solved placement, and the

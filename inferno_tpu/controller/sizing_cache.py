@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from inferno_tpu.config.defaults import rate_within_tolerance
 from inferno_tpu.core.allocation import Allocation, transition_penalty
 
 
@@ -152,7 +153,10 @@ class SizingCache:
         self.misses = 0
 
     def _rate_close(self, cached: float, observed: float) -> bool:
-        return abs(observed - cached) <= self.rel_tolerance * max(cached, 0.0)
+        # the SHARED tolerance predicate (config.defaults): the incremental
+        # dirty scan (parallel/snapshot.py) compares λ with the same
+        # function, so cache-hit and skipped-server decisions never drift
+        return rate_within_tolerance(cached, observed, self.rel_tolerance)
 
     def lookup(
         self, name: str, signature: tuple, arrival_rate: float, cur_allocation

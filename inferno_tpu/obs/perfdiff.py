@@ -13,8 +13,10 @@ shapes are understood, sniffed by content — no flags needed:
   ``bench_rev`` tag exists so this join needs no filename guessing.
 * **bench_full.json** (the full payload ``bench.py`` writes): the
   ``profile`` block's per-phase attribution plus the per-subsystem bench
-  blocks (sizing curve, capacity points, planner, fleet cycle), each
-  carrying its repeat-noise spread where the bench measured one.
+  blocks (sizing curve, capacity points, planner, fleet cycle, the
+  incremental dirty-set points — ``incremental_steady_ms`` /
+  ``incremental_cold_ms``), each carrying its repeat-noise spread where
+  the bench measured one.
 * **live profile artifact**: a single per-cycle profile document
   (``inferno.profile/v1``) or a ``/debug/profile`` download
   (``{"cycles": [...]}``); per-phase wall times and ``*_ms`` counters
@@ -173,6 +175,25 @@ def metrics_from_bench_full(doc: dict) -> dict[str, Metric]:
     for key in ("recorder_overhead_pct", "recorder_replay_ms"):
         if _num(recorder.get(key)) is not None:
             out[key] = Metric(_num(recorder.get(key)))
+
+    # incremental dirty-set reconcile (ISSUE-13, `make bench-incremental`):
+    # the steady-state cycle is the one to watch — a regression there is
+    # named like any other phase. Spread bands ride along where measured.
+    incremental = doc.get("incremental") or {}
+    for key in (
+        "incremental_steady_ms", "incremental_cold_ms",
+        "incremental_all_rate_ms",
+    ):
+        if _num(incremental.get(key)) is not None:
+            out[key] = Metric(
+                _num(incremental.get(key)),
+                _num(incremental.get(f"{key}_spread")) or 0.0,
+            )
+    # compact-line aliases (the BENCH_r trajectory join uses these names)
+    if "incremental_steady_ms" in out:
+        out["incr_steady_ms"] = out["incremental_steady_ms"]
+    if "incremental_cold_ms" in out:
+        out["incr_cold_ms"] = out["incremental_cold_ms"]
     return out
 
 

@@ -436,6 +436,59 @@ def fleet_size(
     )
 
 
+def fleet_refold(
+    params: FleetParams,
+    k_max: int,
+    lambda_star: jax.Array,
+    rate_star: jax.Array,
+    feasible: jax.Array,
+    use_pallas: bool = False,
+) -> FleetResult:
+    """The rate-dependent half of `fleet_size`: given the cached
+    rate-independent bisection outputs (lambda_star, rate_star, feasible
+    — functions of profiles and SLO targets only, never the arrival
+    rate), recompute the offered-load fold and the per-replica operating
+    point. ONE stationary solve instead of the bisection's ~66.
+
+    This is the incremental cycle's λ-only-dirty kernel
+    (parallel/fleet.py, ISSUE-13): a lane whose only changed input is
+    the arrival rate re-derives replicas/cost/itl/ttft/rho here and
+    keeps its cached bisection. The fold (`offered_load` +
+    `fold_replicas`) is the exact f32 arithmetic of `fleet_size`, and
+    the operating-point subgraph is the same ops in the same order —
+    tests pin refold ≡ full-solve bit-parity on replicas/cost (exact by
+    shared arithmetic) and on itl/ttft/rho within the incremental
+    path's own program (the incremental path routes EVERY solve through
+    the split programs so its outputs are self-consistent bit-for-bit;
+    see tests/test_incremental.py batch-invariance pins)."""
+    solve = _get_solver(use_pallas)
+    grid = _make_grid(params, k_max)
+    one = jnp.ones_like(params.alpha)
+    lam_min = _service_rate(params, one) * _RATE_EPSILON
+
+    total = offered_load(params.total_rate, params.target_tps, params.out_tokens)
+    replicas = fold_replicas(total, rate_star, params.min_replicas)
+    cost = replicas.astype(jnp.float32) * params.cost_per_replica
+
+    per_replica_rate = total / replicas.astype(jnp.float32) / 1000.0
+    per_replica_rate = jnp.maximum(per_replica_rate, lam_min)
+    wait, serv, in_servers, _ = solve(per_replica_rate, grid)
+    conc = _concurrency(params, serv)
+    prefill = jnp.where(
+        params.in_tokens > 0, params.gamma + params.delta * params.in_tokens * conc, 0.0
+    )
+    return FleetResult(
+        feasible=feasible,
+        lambda_star=lambda_star,
+        rate_star=rate_star,
+        num_replicas=replicas,
+        cost=cost,
+        itl=params.alpha + params.beta * conc,
+        ttft=wait + prefill,
+        rho=jnp.clip(in_servers / grid.nmax, 0.0, 1.0),
+    )
+
+
 def make_fleet_size_fn(
     k_max: int, n_iters: int = DEFAULT_BISECT_ITERS, use_pallas: bool = False
 ):
@@ -592,6 +645,55 @@ def tandem_fleet_size(
     return FleetResult(
         feasible=feasible,
         lambda_star=lam_star,
+        rate_star=rate_star,
+        num_replicas=replicas,
+        cost=cost,
+        itl=itl,
+        ttft=ttft,
+        rho=rho,
+    )
+
+
+def tandem_refold(
+    params: TandemParams,
+    k_max: int,
+    lambda_star: jax.Array,
+    rate_star: jax.Array,
+    feasible: jax.Array,
+    use_pallas: bool = False,
+) -> FleetResult:
+    """The rate-dependent half of `tandem_fleet_size` — the disaggregated
+    analogue of `fleet_refold`: fold the offered load against the cached
+    per-unit capacity and re-evaluate the tandem operating point (one
+    two-stage evaluation instead of the bisection's ~66)."""
+    solve = _get_solver(use_pallas)
+    nd = _tandem_num_decodes(params)
+    p_slope = params.delta * params.in_tokens
+    gp = _make_stage_grid(
+        params.gamma, p_slope, params.prefill_batch, params.prefill_cap, k_max
+    )
+    gd = _make_stage_grid(
+        nd * params.alpha, nd * params.beta, params.decode_batch, params.decode_cap,
+        k_max,
+    )
+    pb = params.prefill_batch.astype(jnp.float32)
+    db = params.decode_batch.astype(jnp.float32)
+    mu_p_full = pb / (params.gamma + p_slope * pb)
+    mu_d_full = db / (nd * (params.alpha + params.beta * db))
+    unit_max = jnp.minimum(
+        mu_p_full * params.prefill_slices, mu_d_full * params.decode_slices
+    )
+    lam_min = unit_max * _RATE_EPSILON
+
+    total = offered_load(params.total_rate, params.target_tps, params.out_tokens)
+    replicas = fold_replicas(total, rate_star, params.min_replicas)
+    cost = replicas.astype(jnp.float32) * params.cost_per_replica
+
+    per_unit = jnp.maximum(total / replicas.astype(jnp.float32) / 1000.0, lam_min)
+    ttft, itl, rho, _ = _tandem_eval(per_unit, params, gp, gd, solve)
+    return FleetResult(
+        feasible=feasible,
+        lambda_star=lambda_star,
         rate_star=rate_star,
         num_replicas=replicas,
         cost=cost,

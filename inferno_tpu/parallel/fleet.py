@@ -219,16 +219,26 @@ def _get_snapshot():
     return _snapshot
 
 
-def _snapshot_plan(system: System, only: set[str] | None, kind: str):
+def _snapshot_plan(
+    system: System, only: set[str] | None, kind: str,
+    known_version: int | None = None,
+):
     """Columnar-snapshot packing: O(servers) change detection + O(lanes)
     numpy, with an O(1) version-keyed memo — replaces the per-lane
-    Python walk of the legacy builders below."""
+    Python walk of the legacy builders below. `known_version` skips the
+    change-detection walk when the caller already reconciled the
+    snapshot this cycle (calculate_fleet updates ONCE and hands the
+    version to both kind builders — the walk is O(servers) Python and
+    must not run twice per cycle)."""
     snap = _get_snapshot()
-    t0 = time.perf_counter()
-    version = snap.update(system)
-    # snapshot re-derivation: the O(servers) change-detection walk +
-    # column refresh of changed servers (vs the O(1) memo replay above)
-    _prof.add_ms("snapshot_update_ms", (time.perf_counter() - t0) * 1000.0)
+    if known_version is None:
+        t0 = time.perf_counter()
+        version = snap.update(system)
+        # snapshot re-derivation: the O(servers) change-detection walk +
+        # column refresh of changed servers (vs the O(1) memo replay above)
+        _prof.add_ms("snapshot_update_ms", (time.perf_counter() - t0) * 1000.0)
+    else:
+        version = known_version
     key = (version, None if only is None else frozenset(only))
 
     def build():
@@ -250,20 +260,27 @@ def _snapshot_plan(system: System, only: set[str] | None, kind: str):
 
 
 def reset_fleet_state() -> None:
-    """Drop every cross-cycle cache (plan memo, solve memo, snapshot) —
-    test isolation hook."""
+    """Drop every cross-cycle cache (plan memo, solve memo, snapshot,
+    incremental result tables, greedy charge state) — test isolation
+    hook."""
     _plan_memo.clear()
     _solve_memo.clear()
     if _snapshot is not None:
         _snapshot.reset()
+    from inferno_tpu.parallel import incremental as _inc
+
+    _inc.reset_state()
 
 
-def build_fleet(system: System, only: set[str] | None = None) -> FleetPlan | None:
+def build_fleet(
+    system: System, only: set[str] | None = None,
+    _known_version: int | None = None,
+) -> FleetPlan | None:
     """Flatten all loaded aggregated (server, slice-shape) pairs into a
     FleetParams. Mesh padding happens per occupancy bucket in
     `solve_fleet`, not here."""
     if _snapshot_enabled():
-        return _snapshot_plan(system, only, "agg")
+        return _snapshot_plan(system, only, "agg", _known_version)
     cols: dict[str, list] = {name: [] for name in FleetParams._fields}
     lanes: list[tuple[str, str]] = []
 
@@ -306,14 +323,17 @@ def build_fleet(system: System, only: set[str] | None = None) -> FleetPlan | Non
     )
 
 
-def build_tandem_fleet(system: System, only: set[str] | None = None) -> TandemPlan | None:
+def build_tandem_fleet(
+    system: System, only: set[str] | None = None,
+    _known_version: int | None = None,
+) -> TandemPlan | None:
     """Flatten all loaded disaggregated (server, slice-shape) pairs into a
     TandemParams batch. Eligibility mirrors the scalar path
     (create_allocation + build_disagg_analyzer): lanes the scalar analyzer
     would reject (no prefill stage, invalid spec, non-positive stage
     times) produce no candidate here either."""
     if _snapshot_enabled():
-        return _snapshot_plan(system, only, "tan")
+        return _snapshot_plan(system, only, "tan", _known_version)
     cols: dict[str, list] = {name: [] for name in TandemParams._fields}
     lanes: list[tuple[str, str]] = []
 
@@ -415,47 +435,96 @@ def pad_params_rows(params, total: int):
 
 def _pad_lanes(n: int, chunk: int) -> int:
     """Pad a bucket's lane count to the next power of two (>= 8) up to
-    8192, then to a multiple of 4096, then to a multiple of the mesh
+    2048, then to a multiple of 512, then to a multiple of the mesh
     chunk. The fused multi-bucket program's jit cache is keyed by every
     bucket's lane count, so without coarse padding any single variant
     added to or removed from the fleet would recompile the whole
     pipeline. Power-of-two steps keep small fleets stable within a 2x
-    band; above 8k lanes the band switches to 4096-lane increments —
-    at 10k-variant scale a 2x band would waste up to half the solve on
-    dummy lanes (the padded tail dominated the 10k CPU sizing pass),
-    while 4096-steps bound the waste at ~12% and still only recompile
-    when the fleet crosses a 4k-lane boundary."""
+    band; above 2k lanes the band switches to 512-lane increments — at
+    100k-variant scale the old 4096-band left ~4k dummy lanes in the
+    tandem bucket alone (~8% of the whole cold kernel, ISSUE-13), while
+    512-steps bound the waste under 1% and a fleet still only
+    recompiles when a bucket crosses a 512-lane boundary (structural
+    lane-count changes; λ churn never moves a lane between buckets)."""
     padded = 8
-    while padded < n and padded < 8192:
+    while padded < n and padded < 2048:
         padded *= 2
     if padded < n:
-        padded = -(-n // 4096) * 4096
+        padded = -(-n // 512) * 512
     return padded + ((-padded) % chunk)
 
 
-def _jitted_multi(specs: tuple[tuple[str, int], ...], n_iters: int, use_pallas: bool):
-    """One jitted program solving every occupancy bucket — aggregated
-    ("agg") and disaggregated tandem ("tan") alike — and concatenating the
-    packed results: a single device round trip per cycle. Dispatch
-    latency, not compute, dominates this workload (~15ms per call on a
-    tunneled TPU backend), so fusing B bucket dispatches into one is a
-    ~Bx cycle-time win. Cache key includes each bucket's (kind, K)
-    signature; lane counts are burned into the jit cache by argument
-    shape as usual (coarsely padded by _pad_lanes)."""
+def _jitted_multi(
+    specs: tuple[tuple[str, int], ...],
+    n_iters: int,
+    use_pallas: bool,
+    mesh: jax.sharding.Mesh | None = None,
+):
+    """One jitted program solving every occupancy bucket and
+    concatenating the packed results: a single device round trip per
+    cycle. Dispatch latency, not compute, dominates this workload
+    (~15ms per call on a tunneled TPU backend), so fusing B bucket
+    dispatches into one is a ~Bx cycle-time win.
+
+    Bucket kinds: "agg"/"tan" run the full sizing kernels; "agg-re"/
+    "tan-re" run the rate-dependent refold kernels of the incremental
+    cycle (their subs are (params, lambda_star, rate_star, feasible)
+    tuples). Cache key includes each bucket's (kind, K) signature; lane
+    counts are burned into the jit cache by argument shape as usual
+    (coarsely padded by _pad_lanes).
+
+    With a multi-device `mesh`, every bucket kernel is wrapped in
+    `shard_map` over the padded lane axis (lanes are embarrassingly
+    parallel), so the cold full solve scales with device count; a
+    one-device mesh (or none) compiles the exact single-device program
+    — the fallback is the same code path, not a variant."""
     import jax.numpy as jnp
 
-    from inferno_tpu.ops.queueing import fleet_size, pack_result, tandem_fleet_size
+    from inferno_tpu.ops.queueing import (
+        fleet_refold,
+        fleet_size,
+        pack_result,
+        tandem_fleet_size,
+        tandem_refold,
+    )
 
-    key = (specs, n_iters, use_pallas)
+    mesh_key = None if mesh is None or mesh.size <= 1 else mesh
+    key = (specs, n_iters, use_pallas, mesh_key)
     fn = _fn_cache.get(key)
     if fn is None:
 
-        def multi(*subs):
-            outs = []
-            for (kind, k), sub in zip(specs, subs):
-                sizer = tandem_fleet_size if kind == "tan" else fleet_size
-                outs.append(pack_result(sizer(sub, k, n_iters, use_pallas)))
-            return jnp.concatenate(outs, axis=1)
+        def one(kind, k, sub):
+            if kind == "agg":
+                return pack_result(fleet_size(sub, k, n_iters, use_pallas))
+            if kind == "tan":
+                return pack_result(tandem_fleet_size(sub, k, n_iters, use_pallas))
+            params, lam, rate, feas = sub
+            sizer = fleet_refold if kind == "agg-re" else tandem_refold
+            return pack_result(sizer(params, k, lam, rate, feas, use_pallas))
+
+        if mesh_key is not None:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            from inferno_tpu.parallel.mesh import FLEET_AXIS
+
+            def multi(*subs):
+                outs = []
+                for (kind, k), sub in zip(specs, subs):
+                    sharded = shard_map(
+                        lambda s, kind=kind, k=k: one(kind, k, s),
+                        mesh=mesh_key,
+                        in_specs=P(FLEET_AXIS),
+                        out_specs=P(None, FLEET_AXIS),
+                    )
+                    outs.append(sharded(sub))
+                return jnp.concatenate(outs, axis=1)
+
+        else:
+
+            def multi(*subs):
+                outs = [one(kind, k, sub) for (kind, k), sub in zip(specs, subs)]
+                return jnp.concatenate(outs, axis=1)
 
         fn = jax.jit(multi)
         _fn_cache[key] = fn
@@ -507,7 +576,7 @@ def _solve_all(
             sub = cls(*(a[idx] for a in params_np))
             width = _pad_lanes(len(idx), chunk)
             sub = pad_params_rows(sub, width)
-            if mesh is not None:
+            if mesh is not None and mesh.size > 1:
                 sub = shard_fleet_params(sub, mesh)
             subs.append(sub)
             specs.append((kind, k_bucket))
@@ -525,7 +594,7 @@ def _solve_all(
     if not subs:
         return agg_out, tan_out
 
-    fn = _jitted_multi(tuple(specs), n_iters, use_pallas)
+    fn = _jitted_multi(tuple(specs), n_iters, use_pallas, mesh)
     # compile-vs-execute attribution: jax compiles lazily on the first
     # call per argument-shape signature, so a first-seen (program, lane
     # shapes) call is charged to jit_compile_ms (compile-inclusive — the
@@ -802,6 +871,27 @@ for _name in (
 del _name
 
 
+def candidate_order(
+    sidx: np.ndarray, value: np.ndarray, cost: np.ndarray, rank: np.ndarray,
+    materialization: bool = True,
+):
+    """THE deterministic candidate ordering every writeback and candidate
+    builder must share (full path, incremental writeback, lazy builder —
+    the incremental==full bit-parity contract rides on one definition):
+    a global lexsort by (value, cost, accelerator rank) within per-server
+    segments, plus (optionally) the stable by-server grouping that fixes
+    the materialization/packing order. Returns
+    (order, s_sorted, starts, bounds, order2) — order2 is None when
+    `materialization` is False (the lazy candidates builder doesn't
+    construct LaneAllocations)."""
+    order = np.lexsort((rank, cost, value, sidx))
+    s_sorted = sidx[order]
+    starts = np.flatnonzero(np.r_[True, s_sorted[1:] != s_sorted[:-1]])
+    bounds = np.append(starts, len(s_sorted))
+    order2 = np.argsort(sidx, kind="stable") if materialization else None
+    return order, s_sorted, starts, bounds, order2
+
+
 @dataclasses.dataclass
 class FleetCandidates:
     """Columnar per-server candidate table for the capacity-constrained
@@ -834,12 +924,73 @@ class FleetCandidates:
         return len(self.server)
 
 
+def _incremental_enabled() -> bool:
+    import os
+
+    return os.environ.get("INCREMENTAL_CYCLE", "true").lower() not in (
+        "0", "false", "no", "off",
+    )
+
+
+_env_mesh_cache: list = [None, None]  # (env value, mesh) — identity-stable
+
+
+def _env_mesh() -> jax.sharding.Mesh | None:
+    """SIZING_SHARDS env → a cached 1-D fleet mesh over that many
+    devices (capped at what jax has); unset/0/1 = no mesh. Cached so the
+    solve memo's mesh-identity check keeps holding across cycles."""
+    import os
+
+    raw = os.environ.get("SIZING_SHARDS", "").strip()
+    if not raw:
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    if n <= 1:
+        return None
+    if _env_mesh_cache[0] != n:
+        _env_mesh_cache[0] = n
+        _env_mesh_cache[1] = fleet_mesh(min(n, len(jax.devices())))
+    return _env_mesh_cache[1]
+
+
+def _zero_load_dict(system: System, server) -> dict[str, Allocation] | None:
+    """Closed-form zero-load candidate set for one server (the scalar
+    shortcut shared by the full and incremental writebacks): None when
+    the server has no model/class/target, else dict[acc, Allocation]
+    with the scalar op order — spot discount first, transition penalty
+    on the discounted price, plus the (zero-at-zero-load) risk premium."""
+    model = system.models.get(server.model_name)
+    svc = system.service_classes.get(server.service_class_name)
+    if model is None or svc is None or svc.target_for(server.model_name) is None:
+        return None
+    out: dict[str, Allocation] = {}
+    for acc in server.candidate_accelerators(system).values():
+        perf = model.perf_data.get(acc.name)
+        if perf is None:
+            continue
+        alloc = _zero_load_allocation(server, model, acc, perf)
+        _apply_spot(
+            system, alloc, acc.cost * model.slices_per_replica(acc.name), 0,
+        )
+        alloc.value = (
+            transition_penalty(server.cur_allocation, alloc)
+            + alloc.spot_premium
+        )
+        out[acc.name] = alloc
+    return out
+
+
 def calculate_fleet(
     system: System,
     mesh: jax.sharding.Mesh | None = None,
     use_mesh: bool = False,
     backend: str = "tpu",
     only: set[str] | None = None,
+    lam_tolerance: float = 0.0,
+    max_age_cycles: int = 0,
 ) -> int:
     """Replace System.calculate_all() with the batched fleet path.
 
@@ -859,13 +1010,45 @@ def calculate_fleet(
     per-lane Python writeback loop of r01-r05 is gone: the unlimited
     solver path constructs O(servers) Allocation objects per cycle, not
     O(lanes).
+
+    With INCREMENTAL_CYCLE on (the default) and no `only` subset, jitted
+    backends route through the incremental dirty-set cycle
+    (parallel/incremental.py): the snapshot's scan classifies every
+    server, clean servers replay last cycle's results and allocations
+    untouched, and only dirty lanes run a kernel — the full sizing
+    program for structure changes, the cheap refold for λ-only changes.
+    `lam_tolerance`/`max_age_cycles` are the incremental scan's λ
+    anchoring knobs (the sizing cache's tolerance semantics; 0 = exact).
     """
     if use_mesh and mesh is None:
         mesh = fleet_mesh()
+    if mesh is None:
+        mesh = _env_mesh()  # SIZING_SHARDS
 
     # the candidate table is rebuilt (or cleared) every call — a stale
     # table must never describe lanes of a previous solve
     system.fleet_candidates = None
+    system.fleet_candidates_builder = None
+    system.fleet_dirty = None
+
+    if (
+        _incremental_enabled()
+        and _snapshot_enabled()
+        and only is None
+        and backend in ("tpu", "jax")
+    ):
+        from inferno_tpu.parallel.incremental import incremental_cycle
+
+        return incremental_cycle(
+            system, mesh, backend, lam_tolerance, max_age_cycles
+        )
+    # a non-incremental pass over the state's own System voids the
+    # incremental state: its replay claims about these servers go stale
+    # (a pass over a different System leaves it intact — the tables are
+    # content-addressed through the snapshot)
+    from inferno_tpu.parallel.incremental import reset_state_for
+
+    reset_state_for(system)
 
     for name, server in system.servers.items():
         if only is not None and name not in only:
@@ -881,30 +1064,18 @@ def calculate_fleet(
             continue
         if not (load.arrival_rate == 0 or load.avg_out_tokens == 0):
             continue  # loaded servers go through the batched path
-        model = system.models.get(server.model_name)
-        svc = system.service_classes.get(server.service_class_name)
-        if model is None or svc is None or svc.target_for(server.model_name) is None:
-            continue
-        for acc in server.candidate_accelerators(system).values():
-            perf = model.perf_data.get(acc.name)
-            if perf is None:
-                continue
-            alloc = _zero_load_allocation(server, model, acc, perf)
-            # scalar order: spot discount first, then the transition
-            # penalty on the discounted price, plus the risk premium
-            # (zero here — every zero-load replica is storm-safe slack)
-            _apply_spot(
-                system, alloc,
-                acc.cost * model.slices_per_replica(acc.name), 0,
-            )
-            alloc.value = (
-                transition_penalty(server.cur_allocation, alloc)
-                + alloc.spot_premium
-            )
-            server.all_allocations[acc.name] = alloc
+        allocs = _zero_load_dict(system, server)
+        if allocs:
+            server.all_allocations = allocs
 
-    plan = build_fleet(system, only)
-    tandem = build_tandem_fleet(system, only)
+    known = None
+    if _snapshot_enabled():
+        snap = _get_snapshot()
+        t0 = time.perf_counter()
+        known = snap.update(system)
+        _prof.add_ms("snapshot_update_ms", (time.perf_counter() - t0) * 1000.0)
+    plan = build_fleet(system, only, _known_version=known)
+    tandem = build_tandem_fleet(system, only, _known_version=known)
     system.candidates_calculated = True
     if plan is None and tandem is None:
         return 0
@@ -1017,17 +1188,20 @@ def calculate_fleet(
     ) = (np.concatenate(parts) for parts in zip(*cat))
     # per-server segment-argmin with the deterministic tie-break
     # (value, cost, accelerator rank) — mirrors solve_unlimited's scalar key
-    order = np.lexsort((rank_all, cost_all, val_all, sidx_all))
-    s_sorted = sidx_all[order]
-    starts = np.flatnonzero(np.r_[True, s_sorted[1:] != s_sorted[:-1]])
-    bounds = np.append(starts, len(s_sorted))
+    # materialization order = packing order: ONE stable grouping by
+    # server (ascending cat index within each segment — exactly what a
+    # per-segment np.sort of `order` produced, without 10^5 small sorts)
+    order, s_sorted, starts, bounds, order2 = candidate_order(
+        sidx_all, val_all, cost_all, rank_all
+    )
+    kinds_sorted = kind_all[order2]
+    lanes_sorted = lane_all[order2]
     servers_list = list(system.servers.values())
     for a, b in zip(bounds[:-1], bounds[1:]):
-        picks = order[a:b]
-        sel = np.sort(picks)  # materialization order = packing order
+        first = order[a]
         servers_list[s_sorted[a]].all_allocations = LaneAllocations(
-            src, kind_all[sel], lane_all[sel],
-            (int(kind_all[picks[0]]), int(lane_all[picks[0]])),
+            src, kinds_sorted[a:b], lanes_sorted[a:b],
+            (int(kind_all[first]), int(lane_all[first])),
         )
     # the capacity-constrained solver's columnar input: the same sorted
     # segments the argmin above consumed, one row per feasible lane
@@ -1235,8 +1409,9 @@ def calculate_fleet_batch(
     for s in loaded:
         s.load.arrival_rate = 60.0  # 1 req/s placeholder
     try:
-        plan = build_fleet(system)
-        tandem = build_tandem_fleet(system)
+        known = _get_snapshot().update(system) if _snapshot_enabled() else None
+        plan = build_fleet(system, _known_version=known)
+        tandem = build_tandem_fleet(system, _known_version=known)
         if plan is not None or tandem is not None:
             result, tresult = _solve_or_replay(plan, tandem, mesh, backend)
         else:

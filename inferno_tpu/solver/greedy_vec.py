@@ -340,6 +340,19 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
     back to the scalar `solve_greedy` otherwise — results are
     bit-identical either way."""
     cands = getattr(system, "fleet_candidates", None)
+    builder = getattr(system, "fleet_candidates_builder", None)
+    if cands is None and builder is not None and _vec_enabled():
+        # incremental cycle (parallel/incremental.py): when last cycle's
+        # solve was all-bulk, re-charge the ledger from the persistent
+        # preferred-candidate columns (only dirty servers re-derived)
+        # and skip building the candidate table entirely; any binding
+        # falls through to the exact pass below
+        from inferno_tpu.parallel.incremental import try_greedy_bulk
+
+        if try_greedy_bulk(system, optimizer_spec):
+            return
+        cands = builder()
+        system.fleet_candidates = cands
     if cands is None or not _vec_enabled():
         solve_greedy(system, optimizer_spec)
         return
@@ -449,6 +462,10 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
 
     cur = np.zeros(len(e_pos), np.int64)
     pending: list[tuple[str, int] | None] = [None] * len(e_pos)
+    # all-bulk tracking: next cycle's incremental ledger re-charge is
+    # only sound when every group took the bulk path (no heap walk —
+    # binding releases can unblock lower priorities)
+    used_heap = [False]
 
     def materialize(row: int, pos: int):
         if row < n_table:
@@ -504,6 +521,7 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
         # exact sequential loop: heap keys replicate the scalar solver's
         # sorted list + bisect_left reinsertion (a reinserted entry pops
         # before every queued equal-key entry; newest reinsertion first)
+        used_heap[0] = True
         heap = [
             (int(e_prio[e]), -float(delta0[e]), -float(value0[e]), k, int(e))
             for k, e in enumerate(group)
@@ -625,3 +643,7 @@ def solve_greedy_fleet(system: System, optimizer_spec: OptimizerSpec) -> None:
         bounds = np.append(starts, len(order))
         for a, b in zip(bounds[:-1], bounds[1:]):
             settle(allocate_group(order[a:b]))
+    if getattr(system, "fleet_dirty", None) is not None:
+        from inferno_tpu.parallel.incremental import record_greedy
+
+        record_greedy(system, bulk_only=not used_heap[0])

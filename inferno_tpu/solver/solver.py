@@ -26,7 +26,28 @@ def solve_unlimited(system: System) -> None:
     precomputes. Candidates sized by the fleet path arrive as
     `LaneAllocations` whose `best()` IS that argmin: consuming it keeps
     the solve O(servers) with one materialized Allocation per server
-    instead of a Python scan over every lane."""
+    instead of a Python scan over every lane.
+
+    Systems sized by the incremental fleet cycle
+    (parallel/incremental.py) additionally replay clean servers'
+    standing allocations: on a persistent System only dirty servers'
+    picks are re-applied — bit-identical to the full loop, since a clean
+    server's best() is the exact object it already holds."""
+    if getattr(system, "fleet_dirty", None) is not None:
+        from inferno_tpu.parallel.incremental import (
+            record_unlimited,
+            try_unlimited_replay,
+        )
+
+        if try_unlimited_replay(system):
+            return
+        _solve_unlimited_full(system)
+        record_unlimited(system)
+        return
+    _solve_unlimited_full(system)
+
+
+def _solve_unlimited_full(system: System) -> None:
     for server in system.servers.values():
         server.remove_allocation()
         allocs = server.all_allocations

@@ -1,0 +1,720 @@
+"""Incremental dirty-set reconcile (ISSUE-13): dirty classification,
+clean replay, refold bit-parity, greedy re-charge, shard_map fallback.
+
+The correctness contract under test: with INCREMENTAL_CYCLE on (the
+default), an N-dirty cycle's DECISION SURFACE — accelerator choice,
+replica count, cost, solver value, degradation events — is bit-identical
+to a full solve of the same inputs; the operating-point metrics
+(itl/ttft/rho) of λ-only-dirty lanes come from the refold program, whose
+f32 rounding may differ from the fused kernel at ULP level (compared
+within 1e-4 relative). With INCREMENTAL_CYCLE=0 the path is today's
+full pipeline, byte for byte.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from inferno_tpu.config.types import CapacitySpec, OptimizerSpec
+from inferno_tpu.core import System
+from inferno_tpu.parallel import calculate_fleet, reset_fleet_state
+from inferno_tpu.parallel import incremental as fleet_incremental
+from inferno_tpu.parallel.snapshot import (
+    SCAN_CLEAN,
+    SCAN_FULL,
+    SCAN_RATE,
+    SCAN_VALUE,
+)
+from inferno_tpu.solver.greedy_vec import solve_greedy_fleet
+from inferno_tpu.solver.solver import solve_unlimited
+from inferno_tpu.testing.fleet import fleet_capacity, fleet_system_spec
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_state():
+    reset_fleet_state()
+    yield
+    reset_fleet_state()
+
+
+def _decisions(system: System) -> dict:
+    out = {}
+    for name, server in system.servers.items():
+        a = server.allocation
+        out[name] = None if a is None else (
+            a.accelerator, a.num_replicas, a.cost, a.value,
+            a.itl, a.ttft, a.rho, a.spot_replicas,
+        )
+    return out
+
+
+def _assert_parity(got: dict, want: dict, got_degr=None, want_degr=None):
+    """Decision surface bit-equal; operating point within the refold
+    program's ULP band (see module docstring)."""
+    assert set(got) == set(want)
+    for name, w in want.items():
+        g = got[name]
+        assert (g is None) == (w is None), name
+        if w is None:
+            continue
+        assert g[:4] == w[:4], (name, g[:4], w[:4])  # acc/reps/cost/value
+        assert g[7] == w[7], name  # spot replicas
+        for gv, wv in zip(g[4:7], w[4:7]):
+            assert gv == pytest.approx(wv, rel=1e-4, abs=1e-6), name
+    if want_degr is not None:
+        assert got_degr == want_degr
+
+
+def _reference(system_src: System, spec, limited=False):
+    """Full-path (INCREMENTAL_CYCLE=0, legacy FLEET_SNAPSHOT=0 walk)
+    solve of the same inputs on a FRESH System. Loads, profiles, and
+    SLO targets are shared with the spec by reference, so a fresh
+    System(spec) inherits every in-place mutation; cur allocations are
+    copied explicitly. Leaves the incremental state untouched (the full
+    path only voids state describing its own System)."""
+    prior = {k: os.environ.get(k) for k in ("INCREMENTAL_CYCLE", "FLEET_SNAPSHOT")}
+    os.environ["INCREMENTAL_CYCLE"] = "0"
+    os.environ["FLEET_SNAPSHOT"] = "0"
+    try:
+        ref = System(spec)
+        for ref_s, src_s in zip(
+            ref.servers.values(), system_src.servers.values()
+        ):
+            cur = src_s.cur_allocation
+            ref_s.cur_allocation.accelerator = cur.accelerator
+            ref_s.cur_allocation.num_replicas = cur.num_replicas
+            ref_s.cur_allocation.cost = cur.cost
+        ref.quotas = dict(system_src.quotas)
+        ref.capacity = dict(system_src.capacity)
+        ref.spot = dict(system_src.spot)
+        calculate_fleet(ref, backend="jax")
+        if limited:
+            solve_greedy_fleet(ref, spec.optimizer)
+        else:
+            solve_unlimited(ref)
+        return ref
+    finally:
+        for key, val in prior.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+
+def _perturb(system: System, rng, fraction: float) -> None:
+    servers = list(system.servers.values())
+    for i in rng.choice(
+        len(servers), max(int(len(servers) * fraction), 1), replace=False
+    ):
+        load = servers[i].load
+        if load is not None and load.arrival_rate > 0:
+            load.arrival_rate *= float(rng.uniform(0.6, 1.7))
+
+
+def test_kill_switch_routes_to_full_path(monkeypatch):
+    """INCREMENTAL_CYCLE=0 runs today's pipeline: no dirty info, the
+    candidate table built eagerly, results equal either way."""
+    spec = fleet_system_spec(40, shapes_per_variant=2)
+    inc = System(spec)
+    calculate_fleet(inc, backend="jax")
+    solve_unlimited(inc)
+    assert inc.fleet_dirty is not None
+    assert inc.fleet_candidates is None  # lazy on the incremental path
+
+    monkeypatch.setenv("INCREMENTAL_CYCLE", "0")
+    reset_fleet_state()
+    off = System(spec)
+    calculate_fleet(off, backend="jax")
+    solve_unlimited(off)
+    assert off.fleet_dirty is None
+    assert off.fleet_candidates is not None  # eager, as before this PR
+    _assert_parity(_decisions(inc), _decisions(off))
+
+
+def test_clean_cycle_replays_everything():
+    """An unchanged fleet re-solves nothing: zero dirty servers, the
+    clean servers' allocation OBJECTS stand."""
+    spec = fleet_system_spec(60, shapes_per_variant=2)
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    allocs0 = {n: s.allocation for n, s in system.servers.items()}
+    n = calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    assert n > 0
+    assert len(fd.dirty_pos) == 0
+    assert fd.skipped_servers == len(system.servers)
+    assert fd.dirty_lanes == 0
+    for name, server in system.servers.items():
+        assert server.allocation is allocs0[name], name
+
+
+def test_rate_dirty_refolds_only_those_lanes():
+    spec = fleet_system_spec(80, shapes_per_variant=2)
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    rng = np.random.default_rng(5)
+    _perturb(system, rng, 0.1)
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    assert 0 < len(fd.dirty_pos) < len(system.servers)
+    assert fd.dirty_lanes == fd.refold_lanes > 0  # λ-only: no full kernel
+    codes = set(fd.codes[fd.dirty_pos].tolist())
+    assert codes == {SCAN_RATE}
+    ref = _reference(system, spec)
+    _assert_parity(_decisions(system), _decisions(ref))
+
+
+def test_structure_dirty_runs_full_kernel_for_subset():
+    """A profile-parms replacement re-solves ONLY that variant's lanes
+    through the full kernel (the repack remap keeps everyone else's
+    solved rows), bit-equal to the full reference."""
+    spec = fleet_system_spec(
+        50, shapes_per_variant=2, tandem_every=0, infeasible_every=0
+    )
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    # flip one loaded variant's decode parms in place (shared with spec)
+    victim = next(
+        s for s in system.servers.values()
+        if s.load is not None and s.load.arrival_rate > 0
+    )
+    model = system.models[victim.model_name]
+    for perf in model.perf_data.values():
+        perf.decode_parms = dataclasses.replace(
+            perf.decode_parms, alpha=perf.decode_parms.alpha * 1.07
+        )
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    dirty_names = {list(system.servers)[p] for p in fd.dirty_pos.tolist()}
+    assert victim.name in dirty_names
+    assert fd.refold_lanes == 0
+    assert fd.dirty_lanes >= 1
+    ref = _reference(system, spec)
+    _assert_parity(_decisions(system), _decisions(ref))
+
+
+def test_cur_allocation_change_is_value_dirty():
+    """A changed current allocation re-derives transition penalties and
+    the argmin without any kernel, matching the full reference."""
+    spec = fleet_system_spec(
+        40, shapes_per_variant=2, tandem_every=0, zero_load_every=0,
+        pinned_every=0, infeasible_every=0,
+    )
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    victim = list(system.servers.values())[7]
+    victim.cur_allocation.num_replicas += 3
+    victim.cur_allocation.cost *= 1.5
+    victim.spec.current_alloc.num_replicas = victim.cur_allocation.num_replicas
+    victim.spec.current_alloc.cost = victim.cur_allocation.cost
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    pos = list(system.servers).index(victim.name)
+    assert fd.codes[pos] == SCAN_VALUE
+    assert fd.dirty_lanes == 0  # no kernel at all
+    ref = _reference(system, spec)
+    _assert_parity(_decisions(system), _decisions(ref))
+
+
+def test_value_dirty_zero_load_server_rederives_penalties():
+    """Regression (caught in review): a zero-load server whose CURRENT
+    allocation changed is VALUE-dirty with no lanes — replaying its
+    stale closed-form dict would keep transition penalties computed
+    against the OLD allocation and break decision parity."""
+    spec = fleet_system_spec(
+        12, shapes_per_variant=2, tandem_every=0, zero_load_every=3,
+        pinned_every=0, infeasible_every=0,
+    )
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    victim = next(
+        s for s in system.servers.values()
+        if s.load is not None and s.load.arrival_rate == 0
+    )
+    victim.cur_allocation.num_replicas += 4
+    victim.cur_allocation.cost += 123.0
+    victim.spec.current_alloc.num_replicas = victim.cur_allocation.num_replicas
+    victim.spec.current_alloc.cost = victim.cur_allocation.cost
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    ref = _reference(system, spec)
+    _assert_parity(_decisions(system), _decisions(ref))
+
+
+def test_incremental_matches_full_over_edge_regimes():
+    """Edge fleets (tandem/zero-load/pinned/infeasible, multi-priority)
+    x capacity/quota/spot regimes: N perturbed cycles on a persistent
+    System end bit-equal to the full solve of the same inputs,
+    degradation events included."""
+    base = fleet_system_spec(
+        60, shapes_per_variant=2, priority_classes=3, split_pools=True
+    )
+    cap = fleet_capacity(base, 0.9)
+    reset_fleet_state()
+    regimes = [
+        ("unlimited", {}, False),
+        ("limited+quotas", {
+            "capacity": CapacitySpec(
+                chips=cap, quotas={next(iter(cap)): max(cap[next(iter(cap))] - 8, 4)}
+            ),
+            "optimizer": OptimizerSpec(unlimited=False),
+        }, True),
+    ]
+    import json as _json
+
+    from inferno_tpu.spot.market import parse_spot_pools
+
+    spot_cap = CapacitySpec(chips=cap)
+    spot_cap.spot = parse_spot_pools(_json.dumps({
+        pool: {"discount": 0.6, "hazardPerHr": 0.05, "blastRadius": 0.25,
+               "chips": 64}
+        for pool in cap
+    }))
+    regimes.append((
+        "limited+spot",
+        {"capacity": spot_cap, "optimizer": OptimizerSpec(unlimited=False)},
+        True,
+    ))
+    for label, overrides, limited in regimes:
+        reset_fleet_state()
+        spec = dataclasses.replace(base, **overrides)
+        system = System(spec)
+        rng = np.random.default_rng(11)
+        for _ in range(4):
+            calculate_fleet(system, backend="jax")
+            if limited:
+                solve_greedy_fleet(system, spec.optimizer)
+            else:
+                solve_unlimited(system)
+            _perturb(system, rng, 0.15)
+        calculate_fleet(system, backend="jax")
+        if limited:
+            solve_greedy_fleet(system, spec.optimizer)
+        else:
+            solve_unlimited(system)
+        ref = _reference(system, spec, limited=limited)
+        _assert_parity(
+            _decisions(system), _decisions(ref),
+            system.degradations, ref.degradations,
+        )
+
+
+def test_fuzz_random_flips_bit_parity_50_cycles():
+    """Property-style fuzz (ISSUE-13 satellite): every cycle flips a
+    random subset of λ / profiles / SLO targets / cur allocations /
+    quotas on a persistent fleet, and the incremental cycle must equal
+    the full solve of the same inputs — allocations, decision surface,
+    and degradation events — on every one of 50 cycles."""
+    spec = fleet_system_spec(
+        36, shapes_per_variant=2, tandem_every=5, zero_load_every=9,
+        pinned_every=7, infeasible_every=11,
+    )
+    system = System(spec)
+    rng = np.random.default_rng(42)
+    names = list(system.servers)
+    for cycle in range(50):
+        kind = rng.integers(0, 5)
+        k = int(rng.integers(1, 5))
+        picks = rng.choice(len(names), k, replace=False)
+        if kind == 0:  # λ
+            for i in picks:
+                load = system.servers[names[i]].load
+                if load is not None:
+                    load.arrival_rate = float(
+                        max(load.arrival_rate * rng.uniform(0.3, 2.0),
+                            0.0 if rng.uniform() < 0.05 else 1.0)
+                    )
+        elif kind == 1:  # profile parms (replacement, shared with spec)
+            for i in picks:
+                server = system.servers[names[i]]
+                model = system.models.get(server.model_name)
+                if model is None:
+                    continue
+                for perf in model.perf_data.values():
+                    perf.decode_parms = dataclasses.replace(
+                        perf.decode_parms,
+                        beta=perf.decode_parms.beta * float(rng.uniform(0.9, 1.1)),
+                    )
+        elif kind == 2:  # SLO target (per-model entry in the class)
+            for i in picks:
+                server = system.servers[names[i]]
+                svc = system.service_classes.get(server.service_class_name)
+                t = svc.target_for(server.model_name)
+                if t is None:
+                    continue
+                new = dataclasses.replace(
+                    t, slo_itl=max(t.slo_itl * float(rng.uniform(0.8, 1.2)), 1.0)
+                )
+                svc._targets[server.model_name] = new
+                svc.spec.model_targets[:] = [
+                    new if x.model == server.model_name else x
+                    for x in svc.spec.model_targets
+                ]
+        elif kind == 3:  # current allocation
+            for i in picks:
+                server = system.servers[names[i]]
+                server.cur_allocation.num_replicas = int(rng.integers(0, 6))
+                server.cur_allocation.cost = float(rng.uniform(0, 200))
+                server.spec.current_alloc.num_replicas = (
+                    server.cur_allocation.num_replicas
+                )
+                server.spec.current_alloc.cost = server.cur_allocation.cost
+        else:  # token mix
+            for i in picks:
+                load = system.servers[names[i]].load
+                if load is not None:
+                    load.avg_in_tokens = float(rng.integers(16, 600))
+                    load.avg_out_tokens = float(rng.integers(8, 400))
+        calculate_fleet(system, backend="jax")
+        solve_unlimited(system)
+        ref = _reference(system, spec)
+        _assert_parity(_decisions(system), _decisions(ref))
+
+
+def test_reset_and_reversed_catalog_void_persistent_columns():
+    """ISSUE-13 satellite (the PR 6 mask-cache regression, incremental
+    edition): reset_fleet_state must void the persistent result columns
+    and dirty bookkeeping — sizing fleet A incrementally, then a
+    reversed-catalog fleet B with bit-equal masks, must match B's own
+    reference exactly, accelerator names included."""
+    from fixtures import make_system_spec
+
+    spec_a = make_system_spec()
+    spec_b = dataclasses.replace(
+        spec_a, accelerators=list(reversed(spec_a.accelerators))
+    )
+    a = System(spec_a)
+    calculate_fleet(a, backend="jax")
+    solve_unlimited(a)
+    reset_fleet_state()
+    assert fleet_incremental._state is None  # dirty bookkeeping voided
+    b = System(spec_b)
+    calculate_fleet(b, backend="jax")
+    solve_unlimited(b)
+    ref = _reference(b, spec_b)
+    _assert_parity(_decisions(b), _decisions(ref))
+
+
+def test_lambda_tolerance_shared_with_sizing_cache():
+    """ISSUE-13 satellite: the dirty scan and the sizing cache share ONE
+    tolerance predicate, so a λ wiggle the cache replays as a hit also
+    counts as clean for the dirty set — and the skipped decision is the
+    anchored one, with no drift between the two layers."""
+    from inferno_tpu.config.defaults import rate_within_tolerance
+    from inferno_tpu.controller.sizing_cache import SizingCache
+
+    cache = SizingCache(rel_tolerance=0.05)
+    for anchor, observed in ((100.0, 104.9), (100.0, 105.1), (0.0, 0.1),
+                             (50.0, 47.4), (50.0, 47.6)):
+        assert cache._rate_close(anchor, observed) == rate_within_tolerance(
+            anchor, observed, 0.05
+        )
+
+    spec = fleet_system_spec(
+        30, shapes_per_variant=1, tandem_every=0, zero_load_every=0,
+        pinned_every=0, infeasible_every=0,
+    )
+    system = System(spec)
+    calculate_fleet(system, backend="jax", lam_tolerance=0.05)
+    solve_unlimited(system)
+    before = _decisions(system)
+    alloc_objs = {n: s.allocation for n, s in system.servers.items()}
+    # sub-tolerance wiggle on every server: ALL clean, decisions replay
+    for server in system.servers.values():
+        server.load.arrival_rate *= 1.02
+    calculate_fleet(system, backend="jax", lam_tolerance=0.05)
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    assert len(fd.dirty_pos) == 0
+    assert _decisions(system) == before
+    for n, s in system.servers.items():
+        assert s.allocation is alloc_objs[n]
+    # the same wiggle with tolerance 0 re-solves (exact λ compare)
+    for server in system.servers.values():
+        server.load.arrival_rate *= 1.02
+    calculate_fleet(system, backend="jax", lam_tolerance=0.0)
+    solve_unlimited(system)
+    assert len(system.fleet_dirty.dirty_pos) == len(system.servers)
+
+
+def test_lambda_tolerance_max_age_reanchors():
+    """Persistent sub-tolerance drift re-anchors after max_age_cycles
+    (mirrors SizingCache.max_age_cycles); an identical λ never expires."""
+    spec = fleet_system_spec(
+        10, shapes_per_variant=1, tandem_every=0, zero_load_every=0,
+        pinned_every=0, infeasible_every=0,
+    )
+    system = System(spec)
+    calculate_fleet(system, backend="jax", lam_tolerance=0.10, max_age_cycles=3)
+    solve_unlimited(system)
+    for cycle in range(3):
+        for server in system.servers.values():
+            server.load.arrival_rate *= 1.01  # always within tolerance
+        calculate_fleet(
+            system, backend="jax", lam_tolerance=0.10, max_age_cycles=3
+        )
+        fd = system.fleet_dirty
+        if cycle < 2:
+            assert len(fd.dirty_pos) == 0, cycle
+        else:  # third consecutive drifting-clean cycle: re-anchored
+            assert set(fd.codes[fd.dirty_pos].tolist()) == {SCAN_RATE}
+    # identical λ: no expiry, ever
+    for _ in range(5):
+        calculate_fleet(
+            system, backend="jax", lam_tolerance=0.10, max_age_cycles=3
+        )
+        assert len(system.fleet_dirty.dirty_pos) == 0
+
+
+def test_greedy_incremental_bulk_recharge_and_binding_fallback():
+    """Limited mode: when last cycle was all-bulk, a dirty cycle
+    re-charges the ledger from the persistent preferred columns (no
+    candidate table built) with exact parity; a binding cycle falls back
+    to the exact pass and emits the reference's degradations."""
+    from inferno_tpu.obs.profiler import CycleProfiler
+
+    base = fleet_system_spec(
+        40, shapes_per_variant=2, priority_classes=2, split_pools=True
+    )
+    cap = fleet_capacity(base, 4.0)  # loose: everyone fits
+    reset_fleet_state()
+    spec = dataclasses.replace(
+        base,
+        capacity=CapacitySpec(chips=cap),
+        optimizer=OptimizerSpec(unlimited=False),
+    )
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    solve_greedy_fleet(system, spec.optimizer)  # full pass, records all-bulk
+    assert not system.degradations
+    rng = np.random.default_rng(3)
+    _perturb(system, rng, 0.2)
+    calculate_fleet(system, backend="jax")
+    with CycleProfiler() as p:
+        solve_greedy_fleet(system, spec.optimizer)
+    assert p.counters.get("ledger_incremental_bulk") == 1
+    ref = _reference(system, spec, limited=True)
+    _assert_parity(
+        _decisions(system), _decisions(ref),
+        system.degradations, ref.degradations,
+    )
+    # now bind: shrink capacity so the preferred demand no longer fits
+    tight = {pool: max(chips // 4, 1) for pool, chips in cap.items()}
+    system.capacity = dict(tight)
+    spec.capacity.chips = dict(tight)
+    calculate_fleet(system, backend="jax")  # capacity change => all-dirty
+    with CycleProfiler() as p:
+        solve_greedy_fleet(system, spec.optimizer)
+    assert "ledger_incremental_bulk" not in p.counters  # exact pass ran
+    assert system.degradations
+    ref = _reference(system, spec, limited=True)
+    _assert_parity(
+        _decisions(system), _decisions(ref),
+        system.degradations, ref.degradations,
+    )
+
+
+def test_shard_map_parity_and_single_device_fallback(monkeypatch):
+    """Part (b) of the tentpole: the sharded full-solve path is
+    bit-identical to the single-device program (the conftest forces 8
+    virtual XLA devices, so shard_map genuinely splits lanes), and a
+    one-device mesh falls back to the exact single-device path."""
+    from inferno_tpu.parallel.mesh import fleet_mesh
+
+    spec = fleet_system_spec(48, shapes_per_variant=2)
+    plain = System(spec)
+    calculate_fleet(plain, backend="jax")
+    solve_unlimited(plain)
+    want = _decisions(plain)
+
+    reset_fleet_state()
+    sharded = System(spec)
+    calculate_fleet(sharded, backend="jax", mesh=fleet_mesh(4))
+    solve_unlimited(sharded)
+    assert _decisions(sharded) == want
+
+    reset_fleet_state()
+    env = System(spec)
+    monkeypatch.setenv("SIZING_SHARDS", "4")
+    calculate_fleet(env, backend="jax")
+    solve_unlimited(env)
+    assert _decisions(env) == want
+
+    reset_fleet_state()
+    monkeypatch.delenv("SIZING_SHARDS")
+    one = System(spec)
+    calculate_fleet(one, backend="jax", mesh=fleet_mesh(1))
+    solve_unlimited(one)
+    assert _decisions(one) == want
+
+
+def test_rotating_verification_covers_every_server(monkeypatch):
+    """Regression (caught in review): the rotating deep-verification
+    slice WRAPS — truncating at the fleet end while advancing the cursor
+    mod n skipped the wrapped remainder, so low-index servers starved
+    far past the documented window. Contract: ANY
+    `SCAN_VERIFY_CYCLES`-consecutive-cycle span re-verifies every
+    server's value signature, and an in-place scalar edit (invisible to
+    the identity witnesses) is caught within it."""
+    from inferno_tpu.parallel import snapshot as snap_mod
+
+    monkeypatch.setattr(snap_mod, "SCAN_FULL_SIG_LIMIT", 4)
+    monkeypatch.setattr(snap_mod, "SCAN_VERIFY_CYCLES", 3)
+    spec = fleet_system_spec(
+        10, shapes_per_variant=1, tandem_every=0, zero_load_every=0,
+        pinned_every=0, infeasible_every=0,
+    )
+    system = System(spec)
+    calculate_fleet(system, backend="jax")  # builds the scan state
+    per_cycle: list[set] = []
+    real = snap_mod._structure_sig
+
+    def spy(sys_, server):
+        per_cycle[-1].add(server.name)
+        return real(sys_, server)
+
+    monkeypatch.setattr(snap_mod, "_structure_sig", spy)
+    for _ in range(9):
+        per_cycle.append(set())
+        calculate_fleet(system, backend="jax")
+    everyone = set(system.servers)
+    for i in range(len(per_cycle) - 2):
+        span = per_cycle[i] | per_cycle[i + 1] | per_cycle[i + 2]
+        assert span == everyone, (i, everyone - span)
+    # an in-place scalar edit on the same objects is caught by the sweep
+    victim = list(system.servers.values())[0]
+    perf = next(iter(system.models[victim.model_name].perf_data.values()))
+    perf.max_batch_size = max(perf.max_batch_size // 2, 8)
+    caught = False
+    for _ in range(3):
+        per_cycle.append(set())
+        calculate_fleet(system, backend="jax")
+        if len(system.fleet_dirty.dirty_pos):
+            caught = True
+            break
+    assert caught, "in-place edit never re-verified within the window"
+    solve_unlimited(system)
+    ref = _reference(system, spec)
+    _assert_parity(_decisions(system), _decisions(ref))
+
+
+def test_profiler_counters_cover_dirty_cycle():
+    from inferno_tpu.obs.profiler import CycleProfiler
+
+    spec = fleet_system_spec(40, shapes_per_variant=1)
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    rng = np.random.default_rng(1)
+    _perturb(system, rng, 0.1)
+    with CycleProfiler() as p:
+        calculate_fleet(system, backend="jax")
+        solve_unlimited(system)
+    assert p.counters["dirty_lanes"] == p.counters["refold_lanes"] > 0
+    assert p.counters["skipped_servers"] > 0
+    assert p.counters["solve_replayed_servers"] == p.counters["skipped_servers"]
+    assert p.counters["snapshot_update_ms"] > 0.0
+    assert "incremental_writeback_ms" in p.counters
+
+
+def test_refold_kernel_bit_parity_and_batch_invariance():
+    """The refold program reproduces the full kernel's fold outputs
+    (replicas/cost) BIT-exactly — shared arithmetic — and its own
+    outputs are batch-size-invariant (a lane's result cannot depend on
+    which pad bucket its dirty set landed in)."""
+    import jax
+
+    from inferno_tpu.ops import queueing as Q
+
+    rng = np.random.default_rng(0)
+    n = 192
+    out = rng.integers(16, 384, n).astype(np.float32)
+    mb = np.maximum((rng.integers(8, 61, n) * 128 // out).astype(np.int32), 1)
+    params = Q.FleetParams(
+        alpha=rng.uniform(4, 20, n).astype(np.float32),
+        beta=rng.uniform(0.1, 0.6, n).astype(np.float32),
+        gamma=rng.uniform(1, 8, n).astype(np.float32),
+        delta=rng.uniform(0.005, 0.04, n).astype(np.float32),
+        in_tokens=rng.integers(32, 512, n).astype(np.float32),
+        out_tokens=out,
+        max_batch=mb,
+        occupancy_cap=(mb * 5).astype(np.int32),
+        target_ttft=np.full(n, 1500.0, np.float32),
+        target_itl=np.full(n, 60.0, np.float32),
+        target_tps=np.zeros(n, np.float32),
+        total_rate=rng.uniform(0.5, 15, n).astype(np.float32),
+        min_replicas=np.ones(n, np.int32),
+        cost_per_replica=rng.uniform(20, 60, n).astype(np.float32),
+    )
+    full = jax.tree.map(np.asarray, Q.fleet_size(params, 512))
+    p2 = params._replace(
+        total_rate=(np.asarray(params.total_rate) * 1.31).astype(np.float32)
+    )
+    full2 = jax.tree.map(np.asarray, Q.fleet_size(p2, 512))
+    refold = jax.tree.map(np.asarray, Q.fleet_refold(
+        p2, 512, full.lambda_star, full.rate_star, full.feasible,
+    ))
+    np.testing.assert_array_equal(refold.num_replicas, full2.num_replicas)
+    np.testing.assert_array_equal(refold.cost, full2.cost)
+    np.testing.assert_array_equal(refold.lambda_star, full.lambda_star)
+    # batch invariance of the refold program itself
+    idx = np.arange(0, n, 7)
+    psub = jax.tree.map(lambda a: np.asarray(a)[idx], p2)
+    sub = jax.tree.map(np.asarray, Q.fleet_refold(
+        psub, 512, full.lambda_star[idx], full.rate_star[idx],
+        full.feasible[idx],
+    ))
+    for field in sub._fields:
+        np.testing.assert_array_equal(
+            getattr(sub, field), getattr(refold, field)[idx], err_msg=field
+        )
+
+
+def test_reconciler_publishes_dirty_metrics():
+    """The reconciler maps the cycle's dirty info onto the
+    inferno_cycle_dirty_* series (and nothing when the full path ran)."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_controller import make_cluster, make_prom
+
+    from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
+
+    rec = Reconciler(
+        make_cluster(replicas=1), make_prom(arrival_rps=30.0),
+        ReconcilerConfig(compute_backend="jax"),
+    )
+    rec.run_cycle()
+    rec.run_cycle()
+    inst = rec.instruments
+    assert inst.skipped_servers.get({}) is not None or (
+        inst.dirty_lanes.get({}) is not None
+    )
+    sets = inst.dirty_ratio.labelsets()
+    assert sets, "per-variant dirty marker gauge never populated"
+    # full_name is "name:namespace" — the marker must split it correctly
+    assert sets[0]["namespace"] == "workloads"
+    assert sets[0]["variant_name"] == "llama-premium"
+
+
+def test_no_slow_marker_in_this_module():
+    """Every test here must run in the tier-1 (not slow) suite: the
+    incremental path is default-on and its parity contract must gate
+    every commit."""
+    import pathlib
+
+    src = pathlib.Path(__file__).read_text()
+    assert ("pytest.mark." + "slow") not in src

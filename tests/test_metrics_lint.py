@@ -14,10 +14,12 @@ def test_production_catalog_is_clean():
     # cycle instruments (query counter, cache-lookup gauge,
     # collect-concurrency histogram), the flight-recorder drop counter,
     # the four attainment/model-error scoreboard gauges, the three
-    # spot-market series (placement gauges + preemption counter), and
-    # the six cycle-profiler series (phase wall/CPU histograms, burn
-    # gauge, event + ms counters, memory high-water gauge)
-    assert len(names) == 28
+    # spot-market series (placement gauges + preemption counter), the
+    # six cycle-profiler series (phase wall/CPU histograms, burn gauge,
+    # event + ms counters, memory high-water gauge), and the three
+    # incremental dirty-set series (dirty-lane/skipped-server counters,
+    # per-variant dirty marker gauge)
+    assert len(names) == 31
     assert {"inferno_desired_replicas", "inferno_cycle_duration_seconds",
             "inferno_variant_analysis_seconds", "inferno_solver_seconds",
             "inferno_prom_scrape_seconds"} <= names
@@ -54,6 +56,39 @@ def test_forecast_series_in_catalog():
         assert kind == "gauge"
         assert help_.strip()
         assert name.startswith("inferno_")
+
+
+def test_incremental_dirty_series_in_catalog():
+    """The ISSUE-13 dirty-set series register unconditionally (whether
+    or not INCREMENTAL_CYCLE is enabled), carry unit suffixes, and the
+    per-variant marker gauge prunes with deleted variants."""
+    from inferno_tpu.controller.metrics import CycleInstruments
+
+    registry = build_controller_registry()
+    catalog = {name: (help_, kind) for name, help_, kind in registry.catalog()}
+    expected = {
+        "inferno_cycle_dirty_lanes_total": "counter",
+        "inferno_cycle_skipped_servers_total": "counter",
+        "inferno_cycle_dirty_ratio": "gauge",
+    }
+    for name, kind in expected.items():
+        assert name in catalog, name
+        help_, got_kind = catalog[name]
+        assert got_kind == kind
+        assert help_.strip()
+    # prune contract: a deleted variant's dirty marker must not survive
+    inst = CycleInstruments(Registry())
+    inst.set_dirty_outcome(3, 7, [("ns", "a", True), ("ns", "b", False)])
+    assert inst.dirty_ratio.get(
+        {"namespace": "ns", "variant_name": "a"}
+    ) == 1.0
+    inst.prune_variants({("ns", "b")})
+    assert inst.dirty_ratio.get(
+        {"namespace": "ns", "variant_name": "a"}
+    ) is None
+    assert inst.dirty_ratio.get(
+        {"namespace": "ns", "variant_name": "b"}
+    ) == 0.0
 
 
 def test_lint_flags_missing_prefix_and_help():

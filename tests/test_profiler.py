@@ -179,11 +179,15 @@ def _fresh_fleet_state():
     reset_fleet_state()
 
 
-def test_fleet_counters_attribute_memos_and_jit(_fresh_fleet_state):
+def test_fleet_counters_attribute_memos_and_jit(_fresh_fleet_state, monkeypatch):
     from inferno_tpu.core import System
     from inferno_tpu.parallel import calculate_fleet
     from inferno_tpu.testing.fleet import fleet_system_spec
 
+    # the plan/solve memo counters are the FULL path's attribution; the
+    # incremental path (default on) replaces them with dirty-set
+    # counters, pinned in tests/test_incremental.py
+    monkeypatch.setenv("INCREMENTAL_CYCLE", "0")
     spec = fleet_system_spec(8)
     system = System(spec)
     with CycleProfiler() as p1:
@@ -358,6 +362,10 @@ def test_perfdiff_extracts_all_three_source_shapes():
         ]},
         "planner": {"planner_week_ms": 2500.0},
         "cycles": {"auto_selected_ms": 86.0},
+        "incremental": {"incremental_steady_ms": 90.0,
+                        "incremental_steady_ms_spread": 8.0,
+                        "incremental_cold_ms": 8200.0,
+                        "incremental_all_rate_ms": 3000.0},
     }
     m = perfdiff.extract_metrics(full)
     assert m["cycle_ms"] == {"value": 300.0, "spread": 30.0}
@@ -368,6 +376,13 @@ def test_perfdiff_extracts_all_three_source_shapes():
     assert m["planner_week_ms"]["value"] == 2500.0
     assert m["fleet_cycle_ms"]["value"] == 86.0
     assert "overhead_budget_pct" not in m  # config constant, not a metric
+    # ISSUE-13: the bench-incremental block is named like any other phase
+    assert m["incremental_steady_ms"] == {"value": 90.0, "spread": 8.0}
+    assert m["incremental_cold_ms"]["value"] == 8200.0
+    assert m["incremental_all_rate_ms"]["value"] == 3000.0
+    # compact-line aliases join the BENCH_r trajectory
+    assert m["incr_steady_ms"]["value"] == 90.0
+    assert m["incr_cold_ms"]["value"] == 8200.0
 
     live = {"cycles": [_profile_cycle(100, 20, 10),
                        _profile_cycle(120, 30, 14),
