@@ -6,10 +6,10 @@ IMG ?= inferno-tpu-autoscaler:latest
 CLUSTER ?= inferno-tpu
 
 .PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
-        bench-sizing bench-capacity bench-planner bench-recorder \
-        bench-spot bench-profile bench-incremental perf-gate native lint \
-        lint-metrics manifests-sync docker-build deploy-kind deploy \
-        undeploy clean
+        bench-sizing bench-capacity bench-planner bench-montecarlo \
+        bench-recorder bench-spot bench-profile bench-incremental \
+        perf-gate native lint lint-metrics manifests-sync docker-build \
+        deploy-kind deploy undeploy clean
 
 all: native test
 
@@ -56,6 +56,14 @@ bench-capacity:
 # serial per-timestep loop; recorded in bench_full.json
 bench-planner:
 	$(PYTHON) bench.py --planner
+
+# Monte Carlo seed-axis benchmark (ISSUE-14): a 200-seed 10k-variant
+# flash-crowd week streamed through ONE prepared solve context vs the
+# serial per-seed replay loop; ASSERTS >=10x speedup, bit-identical
+# choice/replica arrays + exact per-seed envelopes at sampled seeds,
+# and slab-bounded peak memory; recorded in bench_full.json
+bench-montecarlo:
+	$(PYTHON) bench.py --montecarlo
 
 # Synthetic 200-variant reconcile-cycle benchmark: serial per-variant
 # collection vs coalesced queries + concurrency + sizing cache

@@ -167,6 +167,19 @@ def metrics_from_bench_full(doc: dict) -> dict[str, Metric]:
     if _num(planner.get("planner_week_ms")) is not None:
         out["planner_week_ms"] = Metric(_num(planner.get("planner_week_ms")))
 
+    # Monte Carlo seed-axis ensemble (ISSUE-14, `make bench-montecarlo`):
+    # the steady-state ensemble wall is the phase to watch, noise-banded
+    # by its recorded warm-repeat spread. mc_cold_ms is deliberately NOT
+    # gated: it is a single unrepeated cold measurement (memo rebuild +
+    # jit dispatch) with no spread to widen the band, and would flap on
+    # shared runners.
+    montecarlo = doc.get("montecarlo") or {}
+    if _num(montecarlo.get("mc_week_ms")) is not None:
+        out["mc_week_ms"] = Metric(
+            _num(montecarlo.get("mc_week_ms")),
+            _num(montecarlo.get("mc_week_ms_spread")) or 0.0,
+        )
+
     cycles = doc.get("cycles") or {}
     if _num(cycles.get("auto_selected_ms")) is not None and "fleet_cycle_ms" not in out:
         out["fleet_cycle_ms"] = Metric(_num(cycles.get("auto_selected_ms")))

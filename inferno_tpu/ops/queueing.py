@@ -40,6 +40,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from inferno_tpu.config.defaults import SLO_MARGIN, STABILITY_SAFETY_FRACTION
 
@@ -331,17 +332,29 @@ def offered_load(total_rate, target_tps, out_tokens, xp=jnp):
     return xp.where(target_tps > 0, target_tps / out_tokens, total_rate)
 
 
-def fold_replicas(total, rate_star, min_replicas, xp=jnp):
+def fold_replicas(total, rate_star, min_replicas, xp=jnp, scratch=None):
     """Replica count for offered load `total` at per-replica capacity
     `rate_star`: the exact ceil/max fold of `fleet_size` (f32 divide,
-    ceil, int32 cast, min-replica and >=1 clamps, in that order). Shared
-    by the jitted kernels and the batched time-axis replay so a host-side
-    numpy replay of T timesteps is bit-identical to T jitted solves —
-    `rate_star` is rate-independent, so the replay hoists the bisection
-    out of the time axis and only this fold runs per timestep."""
+    ceil, int32 cast, min-replica and >=1 clamps). Shared by the jitted
+    kernels and the batched time/seed-axis replay so a host-side numpy
+    replay of any [rows, lanes] slab — rows being timesteps of one
+    trace or the flattened (seeds x steps) axis of a Monte Carlo
+    ensemble — is bit-identical to that many jitted solves: `rate_star`
+    is rate-independent, so the replay hoists the bisection out of both
+    axes and only this fold runs per row.
+
+    The two clamps fuse into one (max(max(r, m), 1) == max(r, max(m, 1))
+    exactly, on int32) so the broadcast [rows, lanes] pass runs once;
+    `scratch` (numpy path only) lets the quotient/ceil reuse a caller
+    buffer instead of allocating two [rows, lanes] temporaries per slab
+    — same f32 divide, ceil, int32 cast, elementwise identical."""
+    floor = xp.maximum(min_replicas, 1)
+    if scratch is not None and xp is np:
+        q = np.divide(total, rate_star, out=scratch)
+        np.ceil(q, out=q)
+        return np.maximum(q.astype("int32"), floor)
     replicas = xp.ceil(total / rate_star).astype("int32")
-    replicas = xp.maximum(replicas, min_replicas)
-    return xp.maximum(replicas, 1)
+    return xp.maximum(replicas, floor)
 
 
 def fleet_analyze(lam: jax.Array, params: FleetParams, k_max: int, use_pallas: bool = False):

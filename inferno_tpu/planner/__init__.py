@@ -17,9 +17,20 @@ CLI: ``python -m inferno_tpu.planner --help`` (see docs/performance.md
 * `replay.replay_scenario` — one scenario through the batched solve,
   aggregated; `forecast=True` adds the forecast-bound sizing pass;
 * `replay.aggregate_replay` — the aggregation alone, for callers that
-  already hold a `FleetBatchResult`.
+  already hold a `FleetBatchResult`;
+* `montecarlo.replay_montecarlo` — a seeded S-member ensemble of one
+  scenario streamed through ONE prepared solve context, summarized into
+  p50/p95/p99/max envelopes for chip demand, cost, and
+  violation-seconds plus tail-risk outputs (first-bind probability, p99
+  peak demand); `montecarlo.survival_failures` is the reserved-quota
+  gate the CLI exits non-zero on.
 """
 
+from inferno_tpu.planner.montecarlo import (
+    percentile_envelope,
+    replay_montecarlo,
+    survival_failures,
+)
 from inferno_tpu.planner.replay import (
     aggregate_replay,
     forecast_bound_rates,
@@ -30,6 +41,7 @@ from inferno_tpu.planner.scenarios import (
     ScenarioTrace,
     base_rates_from_system,
     build_scenarios,
+    ensemble_seeds,
 )
 
 __all__ = [
@@ -38,6 +50,10 @@ __all__ = [
     "aggregate_replay",
     "base_rates_from_system",
     "build_scenarios",
+    "ensemble_seeds",
     "forecast_bound_rates",
+    "percentile_envelope",
+    "replay_montecarlo",
     "replay_scenario",
+    "survival_failures",
 ]

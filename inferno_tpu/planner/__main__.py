@@ -18,6 +18,13 @@ Examples::
     # env FLIGHT_RECORDER_DIR on the live controller) instead of
     # synthetic generators; --forecast works over the real history too
     python -m inferno_tpu.planner --trace /var/lib/inferno/recorder
+
+    # Monte Carlo: 200 seeded replays per scenario folded into
+    # percentile envelopes; exit non-zero unless every configured
+    # bucket survives 99% of seeds without binding — the one-command
+    # "do we have enough reserved quota" answer
+    python -m inferno_tpu.planner --variants 500 --capacity-fraction 0.9 \
+        --scenarios flash_crowd --seeds 200 --survival-percentile 99
 """
 
 from __future__ import annotations
@@ -96,6 +103,19 @@ def main(argv=None) -> int:
     ap.add_argument("--chunk-steps", type=int, default=None,
                     help="timesteps per replay slab (default auto; "
                          "PLANNER_CHUNK_STEPS env)")
+    ap.add_argument("--seeds", type=int, default=None,
+                    help="Monte Carlo mode: replay this many seeded "
+                         "ensemble members per scenario (streamed through "
+                         "ONE prepared solve context) and report "
+                         "p50/p95/p99/max envelopes instead of a single "
+                         "replay (default: env PLANNER_SEEDS, else off; "
+                         "seed derivation: scenarios.ensemble_seeds)")
+    ap.add_argument("--survival-percentile", type=float, default=None,
+                    help="with --seeds: exit non-zero (3) unless every "
+                         "CONFIGURED pool/quota budget survives this "
+                         "percentage of seeds without binding — the "
+                         "reserved-quota gate (e.g. 99 = a 99%% winter "
+                         "peak must fit)")
     ap.add_argument("--forecast", action="store_true",
                     help="add the forecast-bound sizing pass per scenario")
     ap.add_argument("--forecast-horizon-s", type=float, default=None,
@@ -109,6 +129,35 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="",
                     help="write the JSON report here instead of stdout")
     args = ap.parse_args(argv)
+
+    if args.seeds is None:
+        import os
+
+        env = os.environ.get("PLANNER_SEEDS", "").strip()
+        try:
+            args.seeds = int(env) if env else 0
+        except ValueError:
+            raise SystemExit(f"PLANNER_SEEDS={env!r} is not an integer")
+    if args.seeds < 0:
+        # a negative count must not silently degrade to the single-replay
+        # path — the user asked for an ensemble and would get none
+        raise SystemExit("--seeds / PLANNER_SEEDS must be >= 0, "
+                         f"got {args.seeds}")
+    if args.survival_percentile is not None:
+        if args.seeds <= 0:
+            raise SystemExit("--survival-percentile needs --seeds N (or "
+                             "PLANNER_SEEDS) — the gate is a fraction of "
+                             "seeds, there is nothing to gate on a single "
+                             "replay")
+        if not 0.0 < args.survival_percentile <= 100.0:
+            raise SystemExit("--survival-percentile must be in (0, 100]")
+    if args.seeds > 0 and args.trace:
+        raise SystemExit("--seeds replays a synthetic scenario ensemble; "
+                         "a recorded --trace has no seed axis")
+    if args.seeds > 0 and args.forecast:
+        raise SystemExit("--forecast is not supported with --seeds yet: "
+                         "the forecast filter is O(T x S) Python per "
+                         "member and would dominate the ensemble")
 
     if args.trace:
         return _replay_trace(args)
@@ -147,33 +196,78 @@ def main(argv=None) -> int:
     base = base_rates_from_system(system)
 
     names = [s.strip() for s in args.scenarios.split(",") if s.strip()]
-    traces = build_scenarios(
-        names, base, args.steps, args.step_seconds, seed=args.seed
-    )
-    report = {
-        "fleet": {
-            "variants": args.variants,
-            "shapes_per_variant": args.shapes,
-            "seed": args.seed,
-            "backend": backend,
-            "capacity_chips": dict(system.capacity),
-            "quotas": dict(system.quotas),
-            "base_rate_total_rpm": float(base.sum()),
-        },
-        "steps": args.steps,
-        "step_seconds": args.step_seconds,
-        "scenarios": [
-            replay_scenario(
-                system, trace,
-                backend=backend,
-                chunk_steps=args.chunk_steps,
-                include_series=args.series,
-                forecast=args.forecast,
-                forecast_horizon_s=args.forecast_horizon_s,
-            )
-            for trace in traces
-        ],
+    fleet_block = {
+        "variants": args.variants,
+        "shapes_per_variant": args.shapes,
+        "seed": args.seed,
+        "backend": backend,
+        "capacity_chips": dict(system.capacity),
+        "quotas": dict(system.quotas),
+        "base_rate_total_rpm": float(base.sum()),
     }
+    if args.seeds > 0:
+        # Monte Carlo mode: per scenario, an S-member seeded ensemble
+        # streamed through one prepared solve context, folded into
+        # percentile envelopes (planner/montecarlo.py)
+        from inferno_tpu.planner.montecarlo import (
+            replay_montecarlo,
+            survival_failures,
+        )
+        from inferno_tpu.planner.scenarios import GENERATORS
+
+        picked = names or list(GENERATORS)
+        unknown = [n for n in picked if n not in GENERATORS]
+        if unknown:
+            raise SystemExit(
+                f"unknown scenario(s) {unknown}; "
+                f"available: {sorted(GENERATORS)}"
+            )
+        scenarios = [
+            replay_montecarlo(
+                system, name, args.steps, args.step_seconds,
+                seeds=args.seeds, base_seed=args.seed, backend=backend,
+                chunk_steps=args.chunk_steps, include_series=args.series,
+            )
+            for name in picked
+        ]
+        report = {
+            "fleet": fleet_block,
+            "steps": args.steps,
+            "step_seconds": args.step_seconds,
+            "seeds": args.seeds,
+            "scenarios": scenarios,
+        }
+        failures = []
+        if args.survival_percentile is not None:
+            for block in scenarios:
+                for f in survival_failures(block, args.survival_percentile):
+                    failures.append({"scenario": block["scenario"], **f})
+            report["survival_gate"] = {
+                "percentile": args.survival_percentile,
+                "failures": failures,
+                "pass": not failures,
+            }
+    else:
+        traces = build_scenarios(
+            names, base, args.steps, args.step_seconds, seed=args.seed
+        )
+        failures = []
+        report = {
+            "fleet": fleet_block,
+            "steps": args.steps,
+            "step_seconds": args.step_seconds,
+            "scenarios": [
+                replay_scenario(
+                    system, trace,
+                    backend=backend,
+                    chunk_steps=args.chunk_steps,
+                    include_series=args.series,
+                    forecast=args.forecast,
+                    forecast_horizon_s=args.forecast_horizon_s,
+                )
+                for trace in traces
+            ],
+        }
     text = json.dumps(report, indent=1)
     if args.out:
         with open(args.out, "w") as fh:
@@ -181,6 +275,18 @@ def main(argv=None) -> int:
         print(f"wrote {args.out}", file=sys.stderr)
     else:
         print(text)
+    if failures:
+        for f in failures:
+            print(
+                f"survival gate FAILED: {f['kind'][:-1]} {f['bucket']!r} "
+                f"({f['scenario']}) survives only "
+                f"{f['survival_fraction'] * 100.0:.1f}% of seeds "
+                f"(required {args.survival_percentile}%); p99 peak "
+                f"{f['p99_peak_chips']:.0f} chips vs budget "
+                f"{f['budget_chips']:.0f}",
+                file=sys.stderr,
+            )
+        return 3
     return 0
 
 

@@ -128,6 +128,83 @@ def _first_bind(demand: np.ndarray, budget: float, step_seconds: float):
     return t, t * step_seconds
 
 
+def zeroed_fill_step(
+    ledger,
+    configured_pid: np.ndarray,
+    pool_demand_t: np.ndarray,
+    quota_demand_t: np.ndarray,
+    choice_t: np.ndarray,
+    chips_t: np.ndarray,
+    value_t: np.ndarray,
+    prio: np.ndarray,
+) -> list[int]:
+    """The aggregate degradation estimate for ONE binding timestep: fill
+    servers into their capacity buckets in (priority asc, transition-
+    value desc) order — the greedy's group order without the per-step
+    regret reshuffling — and return the priorities of whoever does not
+    fit (one entry per zeroed variant; empty = nothing zeroed). THE one
+    implementation shared by `aggregate_replay` and the Monte Carlo
+    envelope driver (planner/montecarlo.py), so per-seed violation
+    counts are bit-identical across the two paths.
+
+    Only buckets OVER budget at this step can zero anyone: demand in a
+    non-binding bucket fits in any fill order, so servers drawing
+    exclusively from non-binding buckets are skipped and only the
+    binding buckets' budgets are tracked — same outcome as filling
+    everything, at the contested subset's cost."""
+    pool_budget = ledger.pool_remaining.astype(np.float64)
+    quota_budget = ledger.quota_remaining.astype(np.float64)
+    pool_bind = configured_pid & (pool_demand_t > pool_budget)
+    quota_bind = quota_demand_t > quota_budget
+    valid = (choice_t >= 0) & (chips_t > 0)
+    rank_t = np.maximum(choice_t, 0)
+    q1_t, q2_t = ledger.rank_q1[rank_t], ledger.rank_q2[rank_t]
+
+    def quota_hit(q):
+        if not len(quota_bind):  # no quota buckets configured
+            return False
+        return (q >= 0) & quota_bind[np.maximum(q, 0)]
+
+    contested = valid & (
+        pool_bind[ledger.rank_pid[rank_t]]
+        | quota_hit(q1_t)
+        | quota_hit(q2_t)
+    )
+    active = np.flatnonzero(contested)
+    if not len(active):
+        return []
+    order = active[np.lexsort((-value_t[active], prio[active]))]
+    # scalar fill over plain Python ints/floats (numpy-scalar
+    # indexing per element is ~10x slower at 10k-variant scale)
+    needs = chips_t[order].astype(np.float64).tolist()
+    pids = ledger.rank_pid[rank_t[order]].tolist()
+    q1s = q1_t[order].tolist()
+    q2s = q2_t[order].tolist()
+    prios = prio[order].tolist()
+    pbind = pool_bind.tolist()
+    qbind = quota_bind.tolist()
+    prem = pool_budget.tolist()
+    qrem = quota_budget.tolist()
+    zeroed: list[int] = []
+    for k in range(len(order)):
+        need, pid, q1, q2 = needs[k], pids[k], q1s[k], q2s[k]
+        fits = not pbind[pid] or prem[pid] >= need
+        if fits and q1 >= 0 and qbind[q1]:
+            fits = qrem[q1] >= need
+        if fits and q2 >= 0 and qbind[q2]:
+            fits = qrem[q2] >= need
+        if fits:
+            if pbind[pid]:
+                prem[pid] -= need
+            if q1 >= 0 and qbind[q1]:
+                qrem[q1] -= need
+            if q2 >= 0 and qbind[q2]:
+                qrem[q2] -= need
+        else:
+            zeroed.append(prios[k])
+    return zeroed
+
+
 def aggregate_replay(
     system,
     result: FleetBatchResult,
@@ -182,67 +259,18 @@ def aggregate_replay(
     configured_pid = np.asarray(
         [p in configured_pools for p in ledger.pools], bool
     )
-    pool_budget = ledger.pool_remaining.astype(np.float64)
-    quota_budget = ledger.quota_remaining.astype(np.float64)
     for t in np.flatnonzero(binding):
-        # only buckets OVER budget at t can zero anyone: demand in a
-        # non-binding bucket fits in any fill order, so servers drawing
-        # exclusively from non-binding buckets are skipped and only the
-        # binding buckets' budgets are tracked — same outcome as filling
-        # everything, at the contested subset's cost
-        pool_bind = configured_pid & (pool_demand[t] > pool_budget)
-        quota_bind = quota_demand[t] > quota_budget
-        choice_t = result.choice[t]
-        demand_t = result.chips[t]
-        valid = (choice_t >= 0) & (demand_t > 0)
-        rank_t = np.maximum(choice_t, 0)
-        q1_t, q2_t = ledger.rank_q1[rank_t], ledger.rank_q2[rank_t]
-
-        def quota_hit(q):
-            if not len(quota_bind):  # no quota buckets configured
-                return False
-            return (q >= 0) & quota_bind[np.maximum(q, 0)]
-
-        contested = valid & (
-            pool_bind[ledger.rank_pid[rank_t]]
-            | quota_hit(q1_t)
-            | quota_hit(q2_t)
+        zeroed = zeroed_fill_step(
+            ledger, configured_pid, pool_demand[t], quota_demand[t],
+            result.choice[t], result.chips[t], result.value[t], prio,
         )
-        active = np.flatnonzero(contested)
-        if not len(active):
+        if not zeroed:
             continue
-        order = active[np.lexsort((-result.value[t, active], prio[active]))]
-        # scalar fill over plain Python ints/floats (numpy-scalar
-        # indexing per element is ~10x slower at 10k-variant scale)
-        needs = demand_t[order].astype(np.float64).tolist()
-        pids = ledger.rank_pid[rank_t[order]].tolist()
-        q1s = q1_t[order].tolist()
-        q2s = q2_t[order].tolist()
-        prios = prio[order].tolist()
-        pbind = pool_bind.tolist()
-        qbind = quota_bind.tolist()
-        prem = pool_budget.tolist()
-        qrem = quota_budget.tolist()
-        for k in range(len(order)):
-            need, pid, q1, q2 = needs[k], pids[k], q1s[k], q2s[k]
-            fits = not pbind[pid] or prem[pid] >= need
-            if fits and q1 >= 0 and qbind[q1]:
-                fits = qrem[q1] >= need
-            if fits and q2 >= 0 and qbind[q2]:
-                fits = qrem[q2] >= need
-            if fits:
-                if pbind[pid]:
-                    prem[pid] -= need
-                if q1 >= 0 and qbind[q1]:
-                    qrem[q1] -= need
-                if q2 >= 0 and qbind[q2]:
-                    qrem[q2] -= need
-            else:
-                zeroed_steps[t] += 1
-                p = prios[k]
-                zeroed_by_prio[p] = zeroed_by_prio.get(p, 0) + 1
-                if first_zero_step is None:
-                    first_zero_step = int(t)
+        zeroed_steps[t] = len(zeroed)
+        for p in zeroed:
+            zeroed_by_prio[p] = zeroed_by_prio.get(p, 0) + 1
+        if first_zero_step is None:
+            first_zero_step = int(t)
 
     cost_usd_hr = result.cost.astype(np.float64).sum(axis=1) / 100.0
     cost = {

@@ -216,6 +216,36 @@ GENERATORS = {
 }
 
 
+def derive_ensemble_seeds(
+    table: dict, name: str, base_seed: int, count: int,
+    what: str = "scenario",
+) -> list[int]:
+    """THE fixed-generator-index seed derivation for a `count`-member
+    ensemble over any generator table: member k draws
+    ``base_seed + offset(name) + k * len(table)``. The offset is the
+    generator's FIXED position in its table and the stride the FIXED
+    table size, so (a) member 0 is exactly what the single-replay
+    builders (`build_scenarios` / `spot.scenarios.build_storms`)
+    produce for the same (name, base_seed) — a single replay is the
+    S=1 ensemble — and (b) no two (generator, member) pairs of one
+    table ever share a raw seed, regardless of which generators or how
+    many members ride along. One implementation shared by the traffic
+    and storm ensembles so the convention cannot drift between them."""
+    if name not in table:
+        raise ValueError(
+            f"unknown {what} {name!r}; available: {sorted(table)}"
+        )
+    offset = list(table).index(name)
+    stride = len(table)
+    return [base_seed + offset + k * stride for k in range(max(count, 0))]
+
+
+def ensemble_seeds(name: str, base_seed: int, count: int) -> list[int]:
+    """Generator seeds of a `count`-member Monte Carlo ensemble of one
+    traffic scenario (`derive_ensemble_seeds` over GENERATORS)."""
+    return derive_ensemble_seeds(GENERATORS, name, base_seed, count)
+
+
 def build_scenarios(
     names, base: np.ndarray, steps: int, step_seconds: float, seed: int = 0
 ) -> list[ScenarioTrace]:

@@ -125,6 +125,20 @@ STORM_GENERATORS = {
 }
 
 
+def storm_ensemble_seeds(name: str, base_seed: int, count: int) -> list[int]:
+    """Generator seeds of a `count`-member storm ensemble: the planner's
+    ONE fixed-generator-index derivation
+    (`planner.scenarios.derive_ensemble_seeds`) over the storm table,
+    so member 0 is exactly the schedule `build_storms` produces for the
+    same (name, base_seed) and no (storm, member) pair ever shares a
+    raw seed."""
+    from inferno_tpu.planner.scenarios import derive_ensemble_seeds
+
+    return derive_ensemble_seeds(
+        STORM_GENERATORS, name, base_seed, count, what="storm scenario"
+    )
+
+
 def build_storms(
     names, pools: list[str], steps: int, step_seconds: float, seed: int = 0
 ) -> list[StormSchedule]:
@@ -360,20 +374,11 @@ def _risk_blind(spot_map: dict) -> dict:
     }
 
 
-def replay_spot_storm(
-    system_spec,
-    trace,
-    schedule: StormSchedule,
-    backend: str = "jax",
-    chunk_steps: int | None = None,
-) -> dict:
-    """The planner's storm report: one traffic trace solved twice — the
-    risk-blind spot-greedy baseline vs the configured risk model with
-    pre-positioned reserved headroom — and the same seeded storm
-    schedule evaluated against both placements.
-
-    `system_spec` is a `config.types.SystemSpec` whose capacity carries
-    the spot tiers; `trace` a `planner.scenarios.ScenarioTrace`."""
+def _solve_placements(system_spec, trace, backend: str, chunk_steps):
+    """The storm comparison's two placements, solved ONCE per trace:
+    the risk-blind spot-greedy baseline and the configured risk model.
+    Shared by the single-schedule replay and the seeded ensemble (whose
+    members differ only in the storm schedule, never the placement)."""
     import dataclasses as dc
 
     from inferno_tpu.core import System
@@ -397,18 +402,18 @@ def replay_spot_storm(
         )
         return system, result
 
-    sys_blind, res_blind = solve(_risk_blind(spot_map))
-    sys_risk, res_risk = solve(spot_map)
+    blind = solve(_risk_blind(spot_map))
+    risk = solve(spot_map)
+    return blind, risk
+
+
+def _storm_verdict(sys_blind, res_blind, sys_risk, res_risk, schedule):
     reactive = evaluate_storms(sys_blind, res_blind, schedule, False)
     prepositioned = evaluate_storms(sys_risk, res_risk, schedule, True)
     cost_a, cost_b = reactive["total_usd"], prepositioned["total_usd"]
     return {
-        "scenario": trace.name,
         "storm": schedule.name,
         "storm_seed": schedule.seed,
-        "steps": trace.steps,
-        "step_seconds": trace.step_seconds,
-        "variants": len(res_risk.servers),
         "reactive": reactive,
         "prepositioned": prepositioned,
         "violation_s_saved": round(
@@ -418,3 +423,123 @@ def replay_spot_storm(
             100.0 * (cost_b - cost_a) / cost_a if cost_a else 0.0, 3
         ),
     }
+
+
+def replay_spot_storm(
+    system_spec,
+    trace,
+    schedule: StormSchedule,
+    backend: str = "jax",
+    chunk_steps: int | None = None,
+) -> dict:
+    """The planner's storm report: one traffic trace solved twice — the
+    risk-blind spot-greedy baseline vs the configured risk model with
+    pre-positioned reserved headroom — and the same seeded storm
+    schedule evaluated against both placements.
+
+    `system_spec` is a `config.types.SystemSpec` whose capacity carries
+    the spot tiers; `trace` a `planner.scenarios.ScenarioTrace`."""
+    (sys_blind, res_blind), (sys_risk, res_risk) = _solve_placements(
+        system_spec, trace, backend, chunk_steps
+    )
+    return {
+        "scenario": trace.name,
+        "steps": trace.steps,
+        "step_seconds": trace.step_seconds,
+        "variants": len(res_risk.servers),
+        **_storm_verdict(sys_blind, res_blind, sys_risk, res_risk, schedule),
+    }
+
+
+def replay_spot_storm_ensemble(
+    system_spec,
+    trace,
+    storm: str,
+    seeds: int,
+    base_seed: int = 0,
+    backend: str = "jax",
+    chunk_steps: int | None = None,
+) -> dict:
+    """Storm scenarios as a seed axis (the Monte Carlo envelope of
+    ROADMAP item 4, closing item 3's leftover): the two placements are
+    solved ONCE — storms only remove already-placed replicas, so every
+    ensemble member shares them — and `seeds` independently seeded
+    schedules of the named storm generator are evaluated against both,
+    folded into the planner's percentile envelopes
+    (`planner.montecarlo.percentile_envelope`): violation-seconds,
+    recovery time, total cost, and the pre-positioner's saving per
+    member. Member k's schedule derives from
+    `storm_ensemble_seeds(storm, base_seed, ...)[k]` — member 0 is the
+    single-schedule replay's storm, so an ensemble is a strict superset
+    of the canonical comparison."""
+    from inferno_tpu.planner.montecarlo import percentile_envelope
+
+    if storm not in STORM_GENERATORS:
+        raise ValueError(
+            f"unknown storm scenario {storm!r}; "
+            f"available: {sorted(STORM_GENERATORS)}"
+        )
+    (sys_blind, res_blind), (sys_risk, res_risk) = _solve_placements(
+        system_spec, trace, backend, chunk_steps
+    )
+    pools = sorted(getattr(sys_risk, "spot", {}))
+    gen = STORM_GENERATORS[storm]
+    members = []
+    for seed in storm_ensemble_seeds(storm, base_seed, seeds):
+        schedule = gen(pools, trace.steps, trace.step_seconds, seed=seed)
+        members.append(
+            _storm_verdict(sys_blind, res_blind, sys_risk, res_risk, schedule)
+        )
+
+    def env(path) -> dict:
+        return percentile_envelope([path(m) for m in members])
+
+    report = {
+        "scenario": trace.name,
+        "storm": storm,
+        "base_seed": base_seed,
+        "seeds": seeds,
+        "seed_derivation": (
+            "base + fixed storm-generator offset + k * "
+            "len(STORM_GENERATORS) (storm_ensemble_seeds; member 0 == "
+            "the single replay)"
+        ),
+        "steps": trace.steps,
+        "step_seconds": trace.step_seconds,
+        "variants": len(res_risk.servers),
+        "reactive": {
+            "violation_seconds": env(
+                lambda m: m["reactive"]["violation_seconds"]
+            ),
+            "recovery_s_max": env(lambda m: m["reactive"]["recovery_s_max"]),
+            "total_usd": env(lambda m: m["reactive"]["total_usd"]),
+        },
+        "prepositioned": {
+            "violation_seconds": env(
+                lambda m: m["prepositioned"]["violation_seconds"]
+            ),
+            "recovery_s_max": env(
+                lambda m: m["prepositioned"]["recovery_s_max"]
+            ),
+            "total_usd": env(lambda m: m["prepositioned"]["total_usd"]),
+        },
+        "violation_s_saved": env(lambda m: m["violation_s_saved"]),
+        "cost_delta_pct": env(lambda m: m["cost_delta_pct"]),
+        # the tail-risk saving: does pre-positioning still pay at the
+        # WORST seeded storm, not just the canonical one
+        "saving_probability": round(
+            sum(m["violation_s_saved"] > 0 for m in members)
+            / max(len(members), 1), 6,
+        ),
+        "per_seed": {
+            "storm_seed": [m["storm_seed"] for m in members],
+            "violation_s_saved": [m["violation_s_saved"] for m in members],
+            "reactive_violation_s": [
+                m["reactive"]["violation_seconds"] for m in members
+            ],
+            "prepositioned_violation_s": [
+                m["prepositioned"]["violation_seconds"] for m in members
+            ],
+        },
+    }
+    return report

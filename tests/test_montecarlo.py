@@ -1,0 +1,607 @@
+"""Monte Carlo seed-axis solve + envelope planner (ISSUE-14).
+
+The seed-batched [seeds, T, servers] solve must be BIT-IDENTICAL to S
+independent per-seed passes — which are themselves pinned bit-identical
+to the serial per-timestep loop (tests/test_planner.py) — regardless of
+where the flattened (seed x step) chunking lands, including slabs that
+straddle seed boundaries. On top, the Monte Carlo envelope driver's
+per-seed inputs must EXACTLY equal what `aggregate_replay` computes for
+the same seed's trace (integer-valued f64 demand sums are
+order-independent; the cost row sum and the binding fill are shared
+code), so the envelopes summarize the same numbers a serial loop would
+produce. Everything here is CPU-jax, fast tier, deterministic.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from inferno_tpu.core import System
+from inferno_tpu.config.types import CapacitySpec
+from inferno_tpu.parallel import (
+    calculate_fleet,
+    calculate_fleet_batch,
+    prepare_fleet_batch,
+    reset_fleet_state,
+)
+from inferno_tpu.solver.solver import solve_unlimited
+from inferno_tpu.testing.fleet import fleet_capacity, fleet_system_spec
+
+BATCH_FIELDS = ("choice", "replicas", "chips", "cost", "value")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_state():
+    reset_fleet_state()
+    yield
+    reset_fleet_state()
+
+
+def _base_rates(system):
+    return np.asarray(
+        [
+            s.load.arrival_rate if s.load is not None else 0.0
+            for s in system.servers.values()
+        ],
+        np.float64,
+    )
+
+
+def _seeded_ensemble_rates(system, seeds, steps, zero_rows=True):
+    """[seeds, T, S] rate tensor with dispersion and zero-rate rows."""
+    rng = np.random.default_rng(11)
+    base = _base_rates(system)
+    rates = base[None, None, :] * rng.uniform(
+        0.0, 2.5, size=(seeds, steps, len(base))
+    )
+    if zero_rows:
+        rates[rates < 20.0] = 0.0  # force zero-load shortcut cells
+    return rates
+
+
+def test_seed_axis_bit_identical_to_per_seed_and_serial():
+    """[seeds, T, S] in one call == S separate [T, S] calls == the
+    serial per-timestep calculate_fleet + solve_unlimited loop, over
+    the edge fleet (zero-load, infeasible, pinned, tandem lanes)."""
+    spec = fleet_system_spec(25, shapes_per_variant=2)
+    system = System(spec)
+    rates = _seeded_ensemble_rates(system, 3, 4)
+    ensemble = calculate_fleet_batch(system, rates, backend="jax")
+    assert ensemble.choice.shape == rates.shape
+
+    for k in range(3):
+        per_seed = calculate_fleet_batch(system, rates[k], backend="jax")
+        for field in BATCH_FIELDS:
+            np.testing.assert_array_equal(
+                getattr(ensemble, field)[k], getattr(per_seed, field),
+                err_msg=f"seed {k} field {field}",
+            )
+
+    acc_idx = {a: i for i, a in enumerate(sorted(system.accelerators))}
+    reset_fleet_state()
+    oracle = System(spec)
+    for k in range(3):
+        for t in range(4):
+            for j, server in enumerate(oracle.servers.values()):
+                if server.load is not None:
+                    server.load.arrival_rate = float(rates[k, t, j])
+            calculate_fleet(oracle, backend="jax")
+            solve_unlimited(oracle)
+            for j, server in enumerate(oracle.servers.values()):
+                a = server.allocation
+                got = (
+                    (-1, 0)
+                    if a is None or not a.accelerator
+                    else (acc_idx[a.accelerator], a.num_replicas)
+                )
+                want = (
+                    int(ensemble.choice[k, t, j]),
+                    int(ensemble.replicas[k, t, j]),
+                )
+                assert got == want, f"seed {k} step {t} server {j}"
+
+
+def test_chunking_invariance_across_seed_boundaries():
+    """Chunk sizes that split a seed mid-trace, align with seed
+    boundaries, or swallow the whole flattened axis must all produce
+    identical arrays — a seed boundary is just another row."""
+    spec = fleet_system_spec(16, shapes_per_variant=2)
+    system = System(spec)
+    rates = _seeded_ensemble_rates(system, 4, 5)
+    full = calculate_fleet_batch(
+        system, rates, backend="jax", chunk_steps=4 * 5
+    )
+    for chunk in (1, 3, 5, 7):
+        other = calculate_fleet_batch(
+            system, rates, backend="jax", chunk_steps=chunk
+        )
+        for field in BATCH_FIELDS:
+            np.testing.assert_array_equal(
+                getattr(full, field), getattr(other, field),
+                err_msg=f"chunk {chunk} field {field}",
+            )
+
+
+def test_zero_load_seed_shortcut():
+    """A seed whose rates are ALL zero inside an ensemble must equal
+    the standalone all-zero solve (the closed-form shortcut, built
+    lazily once per prepared context) bit-for-bit."""
+    spec = fleet_system_spec(14, shapes_per_variant=2)
+    system = System(spec)
+    rates = _seeded_ensemble_rates(system, 3, 4, zero_rows=False)
+    rates[1] = 0.0  # the zero-load seed
+    ensemble = calculate_fleet_batch(system, rates, backend="jax")
+    standalone = calculate_fleet_batch(
+        system, np.zeros_like(rates[1]), backend="jax"
+    )
+    for field in BATCH_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(ensemble, field)[1], getattr(standalone, field),
+            err_msg=field,
+        )
+    # the zero seed picked the closed-form candidates, not -1 everywhere
+    assert (ensemble.choice[1] >= 0).any()
+
+
+@pytest.mark.parametrize("shapes", [1, 2])
+def test_consume_mode_matches_materialized(shapes):
+    """Streaming slabs (both the single-lane fast path and the generic
+    segment-argmin path) must carry exactly the materialized arrays,
+    and a needs subset must match field-for-field."""
+    spec = fleet_system_spec(15, shapes_per_variant=shapes)
+    system = System(spec)
+    rates = _seeded_ensemble_rates(system, 2, 6)
+    flat = rates.reshape(-1, len(system.servers))
+    prep = prepare_fleet_batch(system, backend="jax")
+    assert prep.all_seg1 == (shapes == 1)
+    materialized = prep.solve(rates)
+
+    got = {f: np.zeros_like(getattr(materialized, f).reshape(flat.shape[0], -1))
+           for f in BATCH_FIELDS}
+
+    def consume(slab):
+        for f in BATCH_FIELDS:
+            got[f][slab.row0 : slab.row0 + slab.rows] = getattr(slab, f)
+        assert slab.lane_reps is not None
+        assert slab.rates.shape == (slab.rows, len(system.servers))
+
+    assert prep.solve(rates, consume=consume, chunk_steps=5) is None
+    for f in BATCH_FIELDS:
+        np.testing.assert_array_equal(
+            got[f].reshape(getattr(materialized, f).shape),
+            getattr(materialized, f), err_msg=f,
+        )
+
+    # needs subset: only the requested surfaces exist, values identical
+    seen = {}
+
+    def consume_subset(slab):
+        assert slab.value is None and slab.choice is None
+        seen.setdefault("cost", []).append(slab.cost.copy())
+        seen.setdefault("chips", []).append(slab.chips.copy())
+
+    prep.solve(
+        rates, consume=consume_subset, needs=("cost", "chips"), chunk_steps=7
+    )
+    np.testing.assert_array_equal(
+        np.concatenate(seen["cost"]).reshape(materialized.cost.shape),
+        materialized.cost,
+    )
+    np.testing.assert_array_equal(
+        np.concatenate(seen["chips"]).reshape(materialized.chips.shape),
+        materialized.chips,
+    )
+
+    with pytest.raises(ValueError, match="unknown batch outputs"):
+        prep.solve(rates, consume=consume_subset, needs=("nope",))
+    # needs without consume would be silently dropped (a materialized
+    # result always carries every surface) — refuse it instead
+    with pytest.raises(ValueError, match="requires"):
+        prep.solve(rates, needs=("cost",))
+
+
+def test_binding_flush_boundary_is_invisible(monkeypatch):
+    """The bounded binding-row flush (review fix: an under-provisioned
+    ensemble where MOST rows bind must not accumulate O(binding_rows x
+    servers) rates/outputs) is a memory bound, not a semantic: a tiny
+    flush batch produces the identical report."""
+    from inferno_tpu.planner import montecarlo
+    from inferno_tpu.planner.montecarlo import replay_montecarlo
+
+    spec = fleet_system_spec(
+        15, shapes_per_variant=1, priority_classes=2, split_pools=True
+    )
+    usage = fleet_capacity(spec, 1.0, backend="jax")
+    reset_fleet_state()
+    spec.capacity = CapacitySpec(
+        chips={p: max(int(c * 0.5), 1) for p, c in usage.items()}
+    )
+    system = System(spec)
+    baseline = replay_montecarlo(
+        system, "diurnal", 8, 3600.0, seeds=3, backend="jax", per_seed=True
+    )
+    assert baseline["binding_rows"] > 4  # the tiny batch actually flushes
+    monkeypatch.setattr(montecarlo, "BINDING_FLUSH_ROWS", 4)
+    reset_fleet_state()
+    flushed = replay_montecarlo(
+        system, "diurnal", 8, 3600.0, seeds=3, backend="jax", per_seed=True
+    )
+    # identical up to the wall-clock profile block
+    baseline.pop("profile"), flushed.pop("profile")
+    assert flushed == baseline
+
+
+def test_prep_zero_table_pins_init_transition_basis():
+    """Review fix: the lazily-built zero-load table must use the
+    current-allocation snapshot captured at prepare time — a prep
+    reused after a reconcile replaced cur_allocation must not mix an
+    old sized basis with a new zero-shortcut basis in one result."""
+    import dataclasses
+
+    spec = fleet_system_spec(10, shapes_per_variant=1)
+    reference_sys = System(spec)
+    zeros = np.zeros((2, len(reference_sys.servers)))
+    reference = calculate_fleet_batch(reference_sys, zeros, backend="jax")
+
+    reset_fleet_state()
+    system = System(spec)
+    prep = prepare_fleet_batch(system, backend="jax")
+    # a reconcile-style update: REPLACE cur allocations after prepare
+    # but before the first zero-rate cell forces the table build
+    for server in system.servers.values():
+        server.cur_allocation = dataclasses.replace(
+            server.cur_allocation, cost=server.cur_allocation.cost + 500.0
+        )
+    got = prep.solve(zeros)
+    for field in BATCH_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(got, field), getattr(reference, field), err_msg=field
+        )
+
+
+def test_batch_still_rejects_bad_rates_with_seed_axis():
+    system = System(fleet_system_spec(5, shapes_per_variant=1))
+    with pytest.raises(ValueError, match="server order"):
+        calculate_fleet_batch(
+            system, np.ones((2, 2, 3)), backend="jax"
+        )
+    with pytest.raises(ValueError, match="server order"):
+        calculate_fleet_batch(
+            system, np.ones((2, 2, 2, len(system.servers))), backend="jax"
+        )
+    with pytest.raises(ValueError, match="finite"):
+        calculate_fleet_batch(
+            system, -np.ones((1, 2, len(system.servers))), backend="jax"
+        )
+
+
+@pytest.mark.parametrize("shapes,capacity", [(1, None), (2, 0.6), (1, 0.6)])
+def test_envelopes_exactly_match_per_seed_aggregation(shapes, capacity):
+    """The MC driver's per-seed inputs — per-pool/per-quota peak, p95,
+    mean chip demand, first-bind steps, violation-seconds, total cost —
+    must EXACTLY equal `aggregate_replay` of the same seed's trace:
+    both the single-lane GEMM fast path and the generic bincount path,
+    loose and binding capacity alike."""
+    from inferno_tpu.planner.montecarlo import replay_montecarlo
+    from inferno_tpu.planner.replay import replay_scenario
+    from inferno_tpu.planner.scenarios import (
+        GENERATORS,
+        base_rates_from_system,
+        ensemble_seeds,
+    )
+
+    spec = fleet_system_spec(
+        18, shapes_per_variant=shapes, priority_classes=3, split_pools=True
+    )
+    if capacity is not None:
+        usage = fleet_capacity(spec, 1.0, backend="jax")
+        reset_fleet_state()
+        spec.capacity = CapacitySpec(
+            chips={p: max(int(c * capacity), 1) for p, c in usage.items()},
+            quotas={"gen0/r0": max(int(usage["gen0"] * 0.3), 1)},
+        )
+    system = System(spec)
+    seeds = 4
+    mc = replay_montecarlo(
+        system, "diurnal", 10, 3600.0, seeds=seeds, base_seed=3,
+        backend="jax", per_seed=True, keep_seeds=(0, 2),
+    )
+    base = base_rates_from_system(system)
+    member_seeds = ensemble_seeds("diurnal", 3, seeds)
+    any_bound = 0
+    for k, seed in enumerate(member_seeds):
+        trace = GENERATORS["diurnal"](base, 10, 3600.0, seed=seed)
+        serial = replay_scenario(system, trace, backend="jax")["reactive"]
+        for pool, stats in serial["pools"].items():
+            kept = mc["pools"][pool]["per_seed"]
+            assert kept["peak"][k] == stats["peak"], (pool, k)
+            assert kept["p95"][k] == stats["p95"], (pool, k)
+            assert kept["mean"][k] == stats["mean"], (pool, k)
+            if "first_bind_step" in stats:
+                assert (
+                    kept["first_bind_step"][k] == stats["first_bind_step"]
+                ), (pool, k)
+        for key, stats in serial["quotas"].items():
+            kept = mc["quotas"][key]["per_seed"]
+            assert kept["peak"][k] == stats["peak"], (key, k)
+            assert kept["first_bind_step"][k] == stats["first_bind_step"]
+        assert (
+            mc["per_seed"]["violation_seconds"][k]
+            == serial["violation_seconds"]
+        ), k
+        assert (
+            mc["per_seed"]["cost_total_usd"][k] == serial["cost"]["total_usd"]
+        ), k
+        if serial["binding_steps"] > 0:
+            any_bound += 1
+        # kept choice/replica arrays == the per-seed batch solve
+        if k in (0, 2):
+            res = calculate_fleet_batch(system, trace.rates, backend="jax")
+            np.testing.assert_array_equal(mc["_kept"][k]["choice"], res.choice)
+            np.testing.assert_array_equal(
+                mc["_kept"][k]["replicas"], res.replicas
+            )
+    # tail risk agrees with the serial replays' binding verdicts
+    assert mc["tail_risk"]["first_bind_probability"] == any_bound / seeds
+    if capacity is not None:
+        assert mc["violation_seconds"]["max"] > 0
+        assert mc["binding_rows"] > 0
+    else:
+        assert mc["violation_seconds"]["max"] == 0.0
+        assert mc["binding_rows"] == 0
+
+
+def test_envelope_shape_and_ordering():
+    """p50 <= p95 <= p99 <= max in every envelope; envelope series
+    (include_series) carry one value per timestep."""
+    from inferno_tpu.planner.montecarlo import (
+        percentile_envelope,
+        replay_montecarlo,
+    )
+
+    env = percentile_envelope([3.0, 1.0, 2.0, 10.0])
+    assert env["p50"] <= env["p95"] <= env["p99"] <= env["max"] == 10.0
+    assert percentile_envelope([]) == {
+        "p50": 0.0, "p95": 0.0, "p99": 0.0, "max": 0.0,
+    }
+
+    system = System(fleet_system_spec(10, shapes_per_variant=1))
+    mc = replay_montecarlo(
+        system, "flash_crowd", 6, 3600.0, seeds=3, backend="jax",
+        include_series=True,
+    )
+    for block in mc["pools"].values():
+        series = block["envelope_series"]
+        assert set(series) == {"p50", "p95", "p99", "max"}
+        assert all(len(v) == 6 for v in series.values())
+        for t in range(6):
+            assert series["p50"][t] <= series["p95"][t] <= series["max"][t]
+    env = mc["cost"]["total_usd"]
+    assert env["p50"] <= env["p95"] <= env["p99"] <= env["max"]
+
+
+def test_ensemble_seed_derivation_is_fixed_and_injective():
+    """Member 0 == the single-replay seed of build_scenarios; offsets
+    come from the FIXED generator table so no (scenario, member) pair
+    ever shares a raw seed."""
+    from inferno_tpu.planner.scenarios import (
+        GENERATORS,
+        build_scenarios,
+        ensemble_seeds,
+    )
+
+    base = np.asarray([60.0, 120.0, 240.0])
+    for name in GENERATORS:
+        members = ensemble_seeds(name, 7, 3)
+        assert len(members) == 3
+        single = build_scenarios([name], base, 4, 3600.0, seed=7)[0]
+        member0 = GENERATORS[name](base, 4, 3600.0, seed=members[0])
+        np.testing.assert_array_equal(single.rates, member0.rates)
+    all_seeds = [
+        s for name in GENERATORS for s in ensemble_seeds(name, 7, 5)
+    ]
+    assert len(all_seeds) == len(set(all_seeds))
+    with pytest.raises(ValueError, match="unknown scenario"):
+        ensemble_seeds("nope", 0, 2)
+
+
+def test_planner_cli_montecarlo_and_survival_gate(tmp_path):
+    """--seeds N produces envelope reports; --survival-percentile exits
+    3 with named failing buckets when a configured budget cannot
+    survive, 0 when it can."""
+    from inferno_tpu.planner.__main__ import main
+
+    out = tmp_path / "mc.json"
+    rc = main([
+        "--variants", "12", "--steps", "6", "--shapes", "1",
+        "--scenarios", "flash_crowd", "--backend", "jax",
+        "--seeds", "4", "--capacity-fraction", "0.5",
+        "--survival-percentile", "99", "--out", str(out),
+    ])
+    assert rc == 3
+    report = json.loads(out.read_text())
+    assert report["seeds"] == 4
+    gate = report["survival_gate"]
+    assert gate["pass"] is False and gate["failures"]
+    failure = gate["failures"][0]
+    assert failure["survival_fraction"] < 0.99
+    assert failure["p99_peak_chips"] > failure["budget_chips"]
+    block = report["scenarios"][0]
+    assert block["scenario"] == "flash_crowd"
+    assert set(block["violation_seconds"]) >= {"p50", "p95", "p99", "max"}
+
+    # generous budgets survive: exit 0, gate recorded as passing
+    reset_fleet_state()
+    out2 = tmp_path / "mc-ok.json"
+    rc = main([
+        "--variants", "12", "--steps", "6", "--shapes", "1",
+        "--scenarios", "diurnal", "--backend", "jax",
+        "--seeds", "3", "--capacity-fraction", "50.0",
+        "--survival-percentile", "99", "--out", str(out2),
+    ])
+    assert rc == 0
+    assert json.loads(out2.read_text())["survival_gate"]["pass"] is True
+
+
+def test_planner_cli_montecarlo_flag_validation():
+    from inferno_tpu.planner.__main__ import main
+
+    with pytest.raises(SystemExit, match="survival-percentile needs"):
+        main(["--variants", "4", "--survival-percentile", "99"])
+    with pytest.raises(SystemExit, match="no seed axis"):
+        main(["--trace", "/nonexistent", "--seeds", "4"])
+    with pytest.raises(SystemExit, match="not supported with --seeds"):
+        main(["--variants", "4", "--seeds", "4", "--forecast"])
+    with pytest.raises(SystemExit, match="must be in"):
+        main(["--variants", "4", "--seeds", "4",
+              "--survival-percentile", "0"])
+    with pytest.raises(SystemExit, match="must be >= 0"):
+        main(["--variants", "4", "--seeds", "-2"])
+    with pytest.raises(SystemExit, match="must be >= 0"):
+        import os
+
+        os.environ["PLANNER_SEEDS"] = "-1"
+        try:
+            main(["--variants", "4"])
+        finally:
+            del os.environ["PLANNER_SEEDS"]
+
+
+def test_spot_storm_ensemble_envelopes():
+    """Storm seeds as an ensemble axis: placements solved once, member
+    0 identical to the single-schedule replay, envelopes ordered."""
+    import dataclasses
+
+    from inferno_tpu.config.types import SpotPoolSpec
+    from inferno_tpu.planner.scenarios import base_rates_from_system, diurnal
+    from inferno_tpu.spot.scenarios import (
+        build_storms,
+        replay_spot_storm,
+        replay_spot_storm_ensemble,
+        storm_ensemble_seeds,
+    )
+
+    spec = fleet_system_spec(30, shapes_per_variant=2)
+    spec.capacity = CapacitySpec(chips={}, spot={"v5e": SpotPoolSpec(
+        discount=0.3, hazard_per_hr=0.005, blast_radius=0.06,
+        recovery_s=1800.0,
+    )})
+    system = System(spec)
+    trace = diurnal(base_rates_from_system(system), 16, 600.0, seed=0)
+    rep = replay_spot_storm_ensemble(
+        spec, trace, "spot_reclaim", seeds=4, base_seed=7, backend="jax"
+    )
+    assert rep["seeds"] == 4 and len(rep["per_seed"]["storm_seed"]) == 4
+    for block in (rep["reactive"], rep["prepositioned"]):
+        env = block["violation_seconds"]
+        assert env["p50"] <= env["p95"] <= env["p99"] <= env["max"]
+    # member 0 == the single replay of the base-derived schedule
+    reset_fleet_state()
+    schedule = build_storms(
+        ["spot_reclaim"], ["v5e"], 16, 600.0, seed=7
+    )[0]
+    assert schedule.seed == storm_ensemble_seeds("spot_reclaim", 7, 1)[0]
+    single = replay_spot_storm(spec, trace, schedule)
+    assert (
+        rep["per_seed"]["reactive_violation_s"][0]
+        == single["reactive"]["violation_seconds"]
+    )
+    assert (
+        rep["per_seed"]["violation_s_saved"][0]
+        == single["violation_s_saved"]
+    )
+    # deterministic
+    reset_fleet_state()
+    again = replay_spot_storm_ensemble(
+        spec, trace, "spot_reclaim", seeds=4, base_seed=7, backend="jax"
+    )
+    assert again == rep
+    with pytest.raises(ValueError, match="unknown storm"):
+        replay_spot_storm_ensemble(spec, trace, "nope", seeds=2)
+
+
+def test_montecarlo_budget_s8():
+    """Fast budget guard (ISSUE-14): an 8-seed, 200-variant, 48-step
+    ensemble — prepared context once, streamed slabs per seed — must
+    fit a generous CPU budget after jit warmup. Catches a return to
+    per-seed prep or per-seed materialization, not box noise
+    (min-of-3, wide ceiling)."""
+    import time
+
+    from inferno_tpu.planner.montecarlo import replay_montecarlo
+
+    BUDGET_MS = 3000.0
+    system = System(fleet_system_spec(200, shapes_per_variant=1))
+    replay_montecarlo(
+        system, "flash_crowd", 48, 3600.0, seeds=1, backend="jax"
+    )  # warmup
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        replay_montecarlo(
+            system, "flash_crowd", 48, 3600.0, seeds=8, backend="jax"
+        )
+        times.append((time.perf_counter() - t0) * 1000.0)
+    assert min(times) <= BUDGET_MS, (
+        f"8-seed 200-variant 48-step ensemble took {min(times):.0f}ms "
+        f"(budget {BUDGET_MS:.0f}ms); the Monte Carlo streaming path "
+        "regressed"
+    )
+
+
+def test_compact_line_carries_mc_keys():
+    """Bench wiring: mc_week_ms and mc_speedup ride the compact line
+    when the montecarlo block is present."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench
+
+    ns_stub = {
+        "chosen_shape": "v5e-4-int8",
+        "per_shape_provenance": {"v5e-4-int8": "measured"},
+        "a100": {"usd_per_mtok": 0.2},
+        "tpu": {"usd_per_mtok": 0.125},
+        "vs_baseline": 1.27,
+    }
+    montecarlo = {"mc_week_ms": 3955.0, "mc_speedup": 12.8}
+    line = bench.compact_line(
+        ns_stub, {"platform": "cpu", "auto_selected_ms": 1.0},
+        {"probed": True, "reachable": False}, montecarlo=montecarlo,
+    )
+    doc = json.loads(line)
+    assert doc["extra"]["mc_week_ms"] == 3955.0
+    assert doc["extra"]["mc_speedup"] == 12.8
+
+
+def test_perfdiff_names_montecarlo_phase():
+    """obs/perfdiff.py normalizes the montecarlo bench block like any
+    other phase, spread band included; mc_cold_ms (a single unrepeated
+    cold measurement with no spread) is deliberately NOT gated."""
+    from inferno_tpu.obs.perfdiff import compare, metrics_from_bench_full
+
+    base = metrics_from_bench_full({
+        "montecarlo": {"mc_week_ms": 4000.0, "mc_week_ms_spread": 50.0,
+                       "mc_cold_ms": 5500.0},
+    })
+    assert base["mc_week_ms"]["value"] == 4000.0
+    assert base["mc_week_ms"]["spread"] == 50.0
+    assert "mc_cold_ms" not in base
+    cand = metrics_from_bench_full({
+        "montecarlo": {"mc_week_ms": 9000.0, "mc_cold_ms": 15000.0},
+    })
+    verdict = compare(base, cand)
+    assert verdict["regressions"] == ["mc_week_ms"]
+
+
+def test_montecarlo_suite_stays_in_fast_tier():
+    """No test in this module may carry the `slow` marker — the parity
+    and budget assertions above must stay inside tier-1's
+    `-m 'not slow'` run."""
+    import pathlib
+
+    marker = "mark." + "slow"  # split so this line doesn't self-match
+    text = (pathlib.Path(__file__).parent / "test_montecarlo.py").read_text()
+    assert marker not in text
