@@ -8,8 +8,8 @@ CLUSTER ?= inferno-tpu
 .PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
         bench-sizing bench-capacity bench-planner bench-montecarlo \
         bench-recorder bench-spot bench-profile bench-incremental \
-        perf-gate native lint lint-metrics manifests-sync docker-build \
-        deploy-kind deploy undeploy clean
+        perf-gate native lint lint-compile lint-metrics lint-invariants \
+        manifests-sync docker-build deploy-kind deploy undeploy clean
 
 all: native test
 
@@ -117,13 +117,26 @@ native:
 	  assert native.available(), native.load_error(); \
 	  print('native solver built:', native._lib_path())"
 
-lint:
+# The real lint gate (blocking in CI): byte-compile, then the metric
+# catalog, then the repo-wide invariant analyzer.
+lint: lint-compile lint-metrics lint-invariants
+
+lint-compile:
 	$(PYTHON) -m compileall -q inferno_tpu tests
 
 # Metric-catalog lint: every registered series needs non-empty help text
-# and the inferno_ name prefix (also enforced by tests/test_metrics_lint.py).
+# that does more than restate the name, the inferno_ prefix, a unit
+# suffix, and lower_snake_case labels (also tests/test_metrics_lint.py).
 lint-metrics:
 	$(PYTHON) -m inferno_tpu.obs.lint
+
+# Invariant analyzer (ISSUE-15, docs/analysis.md): INF001 config
+# registry, INF002 jit-purity, INF003 parity-numerics, INF004
+# lock-discipline, INF005 clock-injection. Non-zero exit on any
+# non-grandfathered finding or stale allowlist entry; the 30 s budget
+# keeps it from ever becoming CI's slow step.
+lint-invariants:
+	$(PYTHON) -m inferno_tpu.analysis --budget-seconds 30
 
 # Keep the Helm chart's CRD copy identical to the canonical manifest.
 manifests-sync:

@@ -1,0 +1,54 @@
+"""Repo-wide invariant analyzer (`make lint-invariants`, ISSUE-15).
+
+Five AST checkers on one shared visitor/reporting core enforce the
+contracts the control plane's correctness story rests on but that no
+compiler checks — the analogue of obs/lint.py's metric-catalog lint, for
+source code:
+
+  INF001 config-registry   every environment read goes through the typed
+                           config/defaults.py accessors AND has a row in
+                           docs/user-guide/configuration.md (diffed both
+                           directions)
+  INF002 jit-purity        functions reachable from jax.jit / shard_map
+                           call sites must not read the environment,
+                           wall clocks, or RNG state, nor mutate module
+                           globals
+  INF003 parity-numerics   in the parity-critical packages (ops/,
+                           parallel/, solver/, planner/, spot/): no
+                           dtype-promoting f32xf64 arithmetic outside
+                           the blessed f64-accumulate-then-f32-cast
+                           idiom, no numpy sorts without a stable kind,
+                           no iteration over hash-ordered sets
+  INF004 lock-discipline   fields written from more than one thread
+                           entry point are accessed under a lock, and
+                           the static lock-order graph is acyclic
+  INF005 clock-injection   wall-clock reads only inside the injectable-
+                           clock seams (Reconciler.clock, the Tracer,
+                           the emulator's virtual-clock plumbing)
+
+Escape hatches: a per-line `# noqa: INF0xx` comment, and the pinned
+allowlist file (analysis/allowlist.txt) that grandfathers existing
+violations explicitly — entries may only be removed, never added (the
+meta-check in tests/test_analysis.py pins the count). The hot-path
+packages ops/, parallel/, solver/ carry ZERO allowlist entries for
+INF002/INF003.
+
+Run `python -m inferno_tpu.analysis` (non-zero exit on findings), or
+see docs/analysis.md for the full rule catalog and rationale.
+"""
+
+from inferno_tpu.analysis.core import (
+    Finding,
+    Module,
+    load_allowlist,
+    load_modules,
+    run_analysis,
+)
+
+__all__ = [
+    "Finding",
+    "Module",
+    "load_allowlist",
+    "load_modules",
+    "run_analysis",
+]

@@ -1,0 +1,103 @@
+"""CLI: `python -m inferno_tpu.analysis` (the `make lint-invariants` gate).
+
+Exit codes: 0 clean, 1 findings (or stale allowlist entries), 2 usage /
+budget exceeded. `--budget-seconds` lets CI assert the analyzer never
+becomes the slow step (the ISSUE-15 bound is 30 s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from inferno_tpu.analysis.core import DEFAULT_ALLOWLIST, RULES, run_analysis
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m inferno_tpu.analysis",
+        description="repo-wide invariant analyzer (INF001-INF005; docs/analysis.md)",
+    )
+    ap.add_argument(
+        "--root", default=".", help="repository root (contains inferno_tpu/ and docs/)"
+    )
+    ap.add_argument(
+        "--allowlist",
+        default=str(DEFAULT_ALLOWLIST),
+        help="pinned grandfather allowlist (default: analysis/allowlist.txt)",
+    )
+    ap.add_argument(
+        "--no-allowlist",
+        action="store_true",
+        help="report every finding, grandfathered or not",
+    )
+    ap.add_argument(
+        "--rules",
+        default="",
+        help="comma-separated subset (e.g. INF001,INF003); default all",
+    )
+    ap.add_argument(
+        "--budget-seconds",
+        type=float,
+        default=0.0,
+        help="fail (exit 2) if the analysis itself exceeds this wall time",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"lint-invariants: unknown rule(s) {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    root = Path(args.root).resolve()
+    # the CLI is an offline gate: wall time here is the gate's own
+    # runtime budget, not control-plane logic (hence the noqa)
+    t0 = time.perf_counter()  # noqa: INF005
+    report = run_analysis(
+        root,
+        allowlist_path=None if args.no_allowlist else Path(args.allowlist),
+        rules=rules,
+    )
+    elapsed = time.perf_counter() - t0  # noqa: INF005
+
+    for f in report.findings:
+        print(f"lint-invariants: {f.render()}", file=sys.stderr)
+    for entry in report.stale_entries:
+        print(
+            f"lint-invariants: stale allowlist entry (fixed? delete its line): {entry}",
+            file=sys.stderr,
+        )
+    status = 0
+    if report.findings or report.stale_entries:
+        status = 1
+    else:
+        print(
+            f"lint-invariants: clean in {elapsed:.1f}s "
+            f"({report.grandfathered} grandfathered, "
+            f"{report.noqa_suppressed} noqa-suppressed)"
+        )
+    if args.budget_seconds and elapsed > args.budget_seconds:
+        print(
+            f"lint-invariants: analyzer took {elapsed:.1f}s "
+            f"> budget {args.budget_seconds:.0f}s",
+            file=sys.stderr,
+        )
+        return 2
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

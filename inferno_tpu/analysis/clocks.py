@@ -1,0 +1,81 @@
+"""INF005 clock-injection: wall-clock reads stay behind injectable seams.
+
+The emu-vs-wall flake class PRs 5-8 kept chasing (tests asserting
+virtual-clock behavior against wall-clock-paced code) exists because
+wall-clock reads leak into logic that has an injectable clock available.
+This rule bans `time.time()/monotonic()/perf_counter()/..._ns()` and
+`datetime.now()/utcnow()/today()` everywhere in the package EXCEPT the
+designated seams, which own the clock and hand it out injectably:
+
+  - obs/trace.py      the Tracer's span clock (constructor-injectable)
+  - emulator/engine.py, emulator/disagg.py
+                      the virtual-clock plumbing itself (the emulated
+                      engines derive their discrete-event clock from
+                      wall time by design; everything downstream reads
+                      the EMULATED clock)
+
+Everything else either takes a clock (Reconciler.clock, the forecaster
+and stabilizer timestamps, LoadGenerator pacing) or is grandfathered
+explicitly in analysis/allowlist.txt — new code must inject.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from inferno_tpu.analysis.core import Finding, Module, QualnameVisitor, dotted
+
+RULE = "INF005"
+
+SEAM_FILES = frozenset(
+    {
+        "inferno_tpu/obs/trace.py",
+        "inferno_tpu/emulator/engine.py",
+        "inferno_tpu/emulator/disagg.py",
+    }
+)
+
+WALL_CALLS = frozenset(
+    {
+        "time.time",
+        "time.monotonic",
+        "time.perf_counter",
+        "time.process_time",
+        "time.time_ns",
+        "time.monotonic_ns",
+        "time.perf_counter_ns",
+        "datetime.now",
+        "datetime.utcnow",
+        "datetime.today",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+        "datetime.datetime.today",
+        "date.today",
+        "datetime.date.today",
+    }
+)
+
+
+class _Visitor(QualnameVisitor):
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted(node.func)
+        if name in WALL_CALLS:
+            self.add(
+                RULE,
+                node,
+                f"wall-clock read {name}() outside an injectable-clock seam; "
+                "take a clock parameter (like Reconciler.clock) or read the "
+                "virtual clock",
+            )
+        self.generic_visit(node)
+
+
+def check(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if mod.path in SEAM_FILES:
+            continue
+        v = _Visitor(mod)
+        v.visit(mod.tree)
+        findings.extend(v.findings)
+    return findings

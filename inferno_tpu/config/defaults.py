@@ -8,6 +8,62 @@ domain where noted.
 
 import enum
 import math
+import os
+
+# -- environment accessors (ISSUE-15) -----------------------------------------
+# THE env-read seam: every `os.environ` read in the package goes through
+# one of these typed accessors, with the variable name as a string
+# literal, so the INF001 config-registry checker
+# (inferno_tpu/analysis/config_registry.py) can enumerate the live
+# configuration surface from source and diff it against the documented
+# table in docs/user-guide/configuration.md — both directions. A direct
+# `os.environ` / `os.getenv` read anywhere else in the package is an
+# INF001 violation.
+
+
+def parse_bool(value: str, default: bool = False) -> bool:
+    """Truthy-string parsing shared by env knobs (env_bool) and ConfigMap
+    knobs (controller/reconciler.py, via the controller.constants
+    re-export) so accepted spellings cannot diverge."""
+    v = (value or "").strip().lower()
+    if not v:
+        return default
+    return v in ("1", "true", "yes", "on")
+
+
+def env_str(name: str, default: str = "") -> str:
+    """String knob; unset returns the default verbatim."""
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer knob; unset or set-empty returns the default (matching the
+    historical `int(os.environ.get(X, d) or d)` call sites)."""
+    raw = os.environ.get(name, "").strip()
+    return default if not raw else int(raw)
+
+
+def env_float(name: str, default: float) -> float:
+    """Float knob; unset or set-empty returns the default."""
+    raw = os.environ.get(name, "").strip()
+    return default if not raw else float(raw)
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    """Opt-IN boolean knob: only 1/true/yes/on enable it; anything else
+    (including garbage) resolves False. Unset/empty = default."""
+    return parse_bool(os.environ.get(name, ""), default)
+
+
+def env_flag(name: str, default: bool = True) -> bool:
+    """Opt-OUT gate (kill switch): only an explicit 0/false/no/off
+    disables it; unset, empty, or garbage leaves it at the historical
+    call sites' permissive reading (anything not falsy = on). Used by the
+    default-on fast paths (FLEET_SNAPSHOT, INCREMENTAL_CYCLE,
+    GREEDY_VECTORIZED) whose semantics predate env_bool."""
+    raw = os.environ.get(name, "true" if default else "false")
+    return raw.lower() not in ("0", "false", "no", "off")
+
 
 # Percentile at which latency SLO targets are interpreted
 # (reference: pkg/config/defaults.go:12).

@@ -10,11 +10,8 @@ CM_CONFIG = "inferno-autoscaler-config"
 CM_ACCELERATOR_COSTS = "accelerator-unit-costs"
 CM_SERVICE_CLASSES = "service-classes-config"
 
-
-def parse_bool(value: str, default: bool = False) -> bool:
-    """Truthy-string parsing shared by env knobs (main.env_bool) and
-    ConfigMap knobs (reconciler) so accepted spellings cannot diverge."""
-    v = (value or "").strip().lower()
-    if not v:
-        return default
-    return v in ("1", "true", "yes", "on")
+# Truthy-string parsing shared by env knobs (config.defaults.env_bool)
+# and ConfigMap knobs (reconciler) so accepted spellings cannot diverge.
+# The definition moved to config/defaults.py with the typed env
+# accessors (ISSUE-15); re-exported here for the existing importers.
+from inferno_tpu.config.defaults import parse_bool  # noqa: E402,F401

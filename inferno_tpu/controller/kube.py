@@ -308,8 +308,10 @@ class RestKubeClient:
         namespace: str | None = None,
         insecure: bool = False,
     ):
-        host = os.environ.get("KUBERNETES_SERVICE_HOST")
-        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        from inferno_tpu.config.defaults import env_str
+
+        host = env_str("KUBERNETES_SERVICE_HOST")
+        port = env_str("KUBERNETES_SERVICE_PORT", "443")
         self.base_url = base_url or (f"https://{host}:{port}" if host else "")
         if not self.base_url:
             raise KubeError("no API server address (KUBERNETES_SERVICE_HOST unset)")

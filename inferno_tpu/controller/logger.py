@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import sys
 import time
 
@@ -60,7 +59,9 @@ def get_logger(name: str = "inferno", stream=None) -> logging.Logger:
         handler.setFormatter(JsonFormatter())
         logger.addHandler(handler)
         logger.propagate = False
-    level = _LEVELS.get(os.environ.get("LOG_LEVEL", "info").lower(), logging.INFO)
+    from inferno_tpu.config.defaults import env_str
+
+    level = _LEVELS.get(env_str("LOG_LEVEL", "info").lower(), logging.INFO)
     logger.setLevel(level)
     return logger
 

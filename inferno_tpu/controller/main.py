@@ -100,28 +100,32 @@ import signal
 import sys
 import time
 
-from inferno_tpu.controller.constants import parse_bool
-
-
-def env_bool(name: str, default: bool = False) -> bool:
-    return parse_bool(os.environ.get(name, ""), default)
+# Typed env accessors (ISSUE-15): every environment read in the package
+# goes through config/defaults.py so the INF001 config-registry checker
+# can diff the live env surface against docs/user-guide/configuration.md.
+# env_bool is re-exported here because main() is its historical home and
+# tests/deploy tooling import it from this module.
+from inferno_tpu.config.defaults import (  # noqa: F401
+    env_bool,
+    env_float,
+    env_int,
+    env_str,
+)
 
 
 def prom_config_from_env():
     from inferno_tpu.controller.promclient import PromConfig
 
     return PromConfig(
-        base_url=os.environ.get("PROMETHEUS_BASE_URL", ""),
-        bearer_token=os.environ.get("PROMETHEUS_BEARER_TOKEN", ""),
-        bearer_token_file=os.environ.get("PROMETHEUS_BEARER_TOKEN_FILE", ""),
-        ca_file=os.environ.get("PROMETHEUS_CA_CERT_PATH", ""),
-        client_cert_file=os.environ.get("PROMETHEUS_CLIENT_CERT_PATH", ""),
-        client_key_file=os.environ.get("PROMETHEUS_CLIENT_KEY_PATH", ""),
+        base_url=env_str("PROMETHEUS_BASE_URL"),
+        bearer_token=env_str("PROMETHEUS_BEARER_TOKEN"),
+        bearer_token_file=env_str("PROMETHEUS_BEARER_TOKEN_FILE"),
+        ca_file=env_str("PROMETHEUS_CA_CERT_PATH"),
+        client_cert_file=env_str("PROMETHEUS_CLIENT_CERT_PATH"),
+        client_key_file=env_str("PROMETHEUS_CLIENT_KEY_PATH"),
         insecure_skip_verify=env_bool("PROMETHEUS_TLS_INSECURE_SKIP_VERIFY"),
         allow_http=env_bool("PROMETHEUS_ALLOW_HTTP"),
-        query_timeout_seconds=float(
-            os.environ.get("PROMETHEUS_QUERY_TIMEOUT", "30") or 30
-        ),
+        query_timeout_seconds=env_float("PROMETHEUS_QUERY_TIMEOUT", 30),
     )
 
 
@@ -166,13 +170,13 @@ def main() -> int:
     emitter = MetricsEmitter(registry)
     # last-K reconcile-cycle traces + decision records, shared between the
     # reconciler (writer) and the metrics listener (/debug/decisions)
-    traces = TraceBuffer(capacity=int(os.environ.get("DECISION_TRACE_BUFFER", "32")))
+    traces = TraceBuffer(capacity=env_int("DECISION_TRACE_BUFFER", 32))
 
     config = ReconcilerConfig(
-        config_namespace=os.environ.get("CONFIG_NAMESPACE", "inferno-system"),
-        engine=os.environ.get("SERVING_ENGINE", "vllm-tpu"),
+        config_namespace=env_str("CONFIG_NAMESPACE", "inferno-system"),
+        engine=env_str("SERVING_ENGINE", "vllm-tpu"),
         scale_to_zero=env_bool("WVA_SCALE_TO_ZERO"),
-        compute_backend=os.environ.get(
+        compute_backend=env_str(
             "COMPUTE_BACKEND", "auto" if env_bool("USE_TPU_FLEET", True) else "scalar"
         ).lower(),
         direct_scale=env_bool("DIRECT_SCALE"),
@@ -183,29 +187,17 @@ def main() -> int:
         # (seconds; keep 0 when an HPA with its own stabilization
         # enacts the gauges)
         predictive_scaling=env_bool("PREDICTIVE_SCALING"),
-        scale_down_stabilization_s=float(
-            os.environ.get("SCALE_DOWN_STABILIZATION_SECONDS", "0") or 0
-        ),
+        scale_down_stabilization_s=env_float("SCALE_DOWN_STABILIZATION_SECONDS", 0),
         # fleet-scale cycle knobs (docs/performance.md)
-        reconcile_concurrency=int(
-            os.environ.get("RECONCILE_CONCURRENCY", "1") or 1
-        ),
+        reconcile_concurrency=env_int("RECONCILE_CONCURRENCY", 1),
         grouped_collection=env_bool("GROUPED_COLLECTION", True),
         sizing_cache=env_bool("SIZING_CACHE"),
-        sizing_cache_tolerance=float(
-            os.environ.get("SIZING_CACHE_TOLERANCE", "0.02") or 0.02
-        ),
+        sizing_cache_tolerance=env_float("SIZING_CACHE_TOLERANCE", 0.02),
         # flight recorder + attainment scoreboard (docs/observability.md)
-        flight_recorder_dir=os.environ.get("FLIGHT_RECORDER_DIR", "").strip(),
-        flight_recorder_max_mb=float(
-            os.environ.get("FLIGHT_RECORDER_MAX_MB", "64") or 64
-        ),
-        flight_recorder_max_age_s=float(
-            os.environ.get("FLIGHT_RECORDER_MAX_AGE_S", "3600") or 3600
-        ),
-        attainment_ewma_gain=float(
-            os.environ.get("ATTAINMENT_EWMA_GAIN", "0.2") or 0.2
-        ),
+        flight_recorder_dir=env_str("FLIGHT_RECORDER_DIR").strip(),
+        flight_recorder_max_mb=env_float("FLIGHT_RECORDER_MAX_MB", 64),
+        flight_recorder_max_age_s=env_float("FLIGHT_RECORDER_MAX_AGE_S", 3600),
+        attainment_ewma_gain=env_float("ATTAINMENT_EWMA_GAIN", 0.2),
         # cycle profiler (docs/observability.md): default-on per-cycle
         # cost attribution; tracemalloc sampling opt-in (it costs CPU)
         cycle_profiler=env_bool("CYCLE_PROFILER", True),
@@ -218,7 +210,7 @@ def main() -> int:
     # /debug/attainment can serve the reconciler's live scoreboard
     server = MetricsServer(
         registry,
-        port=int(os.environ.get("METRICS_PORT", "8443")),
+        port=env_int("METRICS_PORT", 8443),
         tls=TLSConfig.from_env(),
         traces=traces,
         attainment=rec.attainment,
@@ -229,7 +221,7 @@ def main() -> int:
     server.start()
     # dedicated probe port so liveness/readiness don't ride the metrics
     # listener (the manager Deployment probes :8081)
-    health = HealthServer(server.ready_flag, port=int(os.environ.get("HEALTH_PORT", "8081")))
+    health = HealthServer(server.ready_flag, port=env_int("HEALTH_PORT", 8081))
     health.start()
     # readiness heartbeat: both probe listeners share this dict, so a
     # reconcile loop that stops cycling (> 3x interval) fails /readyz
@@ -258,7 +250,7 @@ def main() -> int:
         elector = LeaderElector(
             kube=kube,
             identity=f"{socket.gethostname()}_{os.getpid()}",
-            namespace=os.environ.get("POD_NAMESPACE", "")
+            namespace=env_str("POD_NAMESPACE")
             or getattr(kube, "namespace", "")
             or config.config_namespace,
         )

@@ -216,6 +216,13 @@ class Registry:
                 if isinstance(s, _Histogram)
             ]
 
+    def labelsets(self) -> list[tuple[str, list[dict[str, str]]]]:
+        """(name, label sets with live samples) of every series — the
+        label-name lint surface (obs/lint.py: label names must be
+        lower_snake_case, ISSUE-15)."""
+        with self._lock:
+            return [(s.name, s.labelsets()) for s in self._series.values()]
+
     def render(self) -> str:
         with self._lock:
             lines: list[str] = []
@@ -780,10 +787,10 @@ class TLSConfig:
 
     @classmethod
     def from_env(cls) -> "TLSConfig | None":
-        import os
+        from inferno_tpu.config.defaults import env_str
 
-        cert = os.environ.get("METRICS_TLS_CERT_PATH", "")
-        key = os.environ.get("METRICS_TLS_KEY_PATH", "")
+        cert = env_str("METRICS_TLS_CERT_PATH")
+        key = env_str("METRICS_TLS_KEY_PATH")
         if bool(cert) != bool(key):
             # Half-configured TLS must fail loudly, not silently serve
             # /metrics over plaintext.

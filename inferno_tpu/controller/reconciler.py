@@ -19,13 +19,13 @@ import concurrent.futures
 import dataclasses
 import json
 import logging
-import os
 import threading
 import time
 from typing import Any, Callable
 
 import yaml
 
+from inferno_tpu.config.defaults import env_str
 from inferno_tpu.config.types import (
     AcceleratorSpec,
     AllocationData,
@@ -655,8 +655,8 @@ class Reconciler:
             self.log.error("ignoring TPU_POOL_QUOTAS this cycle: %s", e)
         # the spot tier per pool: ConfigMap key first, env var fallback
         # (emulator/bench runs configure spot without a cluster)
-        raw_spot = data.get("TPU_SPOT_POOLS", "") or os.environ.get(
-            "TPU_SPOT_POOLS", ""
+        raw_spot = data.get("TPU_SPOT_POOLS", "") or env_str(
+            "TPU_SPOT_POOLS"
         )
         try:
             capacity.spot = parse_spot_pools(raw_spot)

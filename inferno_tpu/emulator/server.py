@@ -14,11 +14,11 @@ footprint beyond the engine's budget) get 400; timeouts/overload 503.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from inferno_tpu.config.defaults import env_bool, env_float, env_int, env_str
 from inferno_tpu.controller.engines import engine_for
 from inferno_tpu.emulator.engine import EmulatedEngine, EngineProfile
 
@@ -169,34 +169,34 @@ def render_engine_metrics(e: EmulatedEngine, model_id: str, vocab) -> str:
 
 def main() -> None:
     engine = None
-    if os.environ.get("DISAGG", "").lower() in ("1", "true", "yes"):
+    if env_bool("DISAGG"):
         # disaggregated (JetStream-style) replica unit: separate prefill
         # and decode engine pools coupled by a KV-transfer delay
         from inferno_tpu.emulator.disagg import DisaggEngine, DisaggProfile
 
         engine = DisaggEngine(DisaggProfile(
-            alpha=float(os.environ.get("DECODE_ALPHA", "20.0")),
-            beta=float(os.environ.get("DECODE_BETA", "0.4")),
-            gamma=float(os.environ.get("PREFILL_GAMMA", "5.0")),
-            delta=float(os.environ.get("PREFILL_DELTA", "0.02")),
-            prefill_max_batch=int(os.environ.get("PREFILL_MAX_BATCH", "8")),
-            decode_max_batch=int(os.environ.get("MAX_BATCH", "64")),
-            prefill_engines=int(os.environ.get("DISAGG_PREFILL_ENGINES", "1")),
-            decode_engines=int(os.environ.get("DISAGG_DECODE_ENGINES", "1")),
-            kv_transfer_ms=float(os.environ.get("KV_TRANSFER_MS", "2.0")),
+            alpha=env_float("DECODE_ALPHA", 20.0),
+            beta=env_float("DECODE_BETA", 0.4),
+            gamma=env_float("PREFILL_GAMMA", 5.0),
+            delta=env_float("PREFILL_DELTA", 0.02),
+            prefill_max_batch=env_int("PREFILL_MAX_BATCH", 8),
+            decode_max_batch=env_int("MAX_BATCH", 64),
+            prefill_engines=env_int("DISAGG_PREFILL_ENGINES", 1),
+            decode_engines=env_int("DISAGG_DECODE_ENGINES", 1),
+            kv_transfer_ms=env_float("KV_TRANSFER_MS", 2.0),
         ))
     profile = EngineProfile(
-        alpha=float(os.environ.get("DECODE_ALPHA", "20.0")),
-        beta=float(os.environ.get("DECODE_BETA", "0.4")),
-        gamma=float(os.environ.get("PREFILL_GAMMA", "5.0")),
-        delta=float(os.environ.get("PREFILL_DELTA", "0.02")),
-        max_batch=int(os.environ.get("MAX_BATCH", "64")),
+        alpha=env_float("DECODE_ALPHA", 20.0),
+        beta=env_float("DECODE_BETA", 0.4),
+        gamma=env_float("PREFILL_GAMMA", 5.0),
+        delta=env_float("PREFILL_DELTA", 0.02),
+        max_batch=env_int("MAX_BATCH", 64),
     )
     server = EmulatorServer(
-        model_id=os.environ.get("MODEL_ID", "emulated/model"),
+        model_id=env_str("MODEL_ID", "emulated/model"),
         profile=profile,
-        engine_name=os.environ.get("ENGINE", "vllm-tpu"),
-        port=int(os.environ.get("PORT", "8000")),
+        engine_name=env_str("ENGINE", "vllm-tpu"),
+        port=env_int("PORT", 8000),
         engine=engine,
     )
     server.start()

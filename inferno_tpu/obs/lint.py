@@ -1,19 +1,34 @@
 """Metric-catalog lint (`make lint-metrics`).
 
 Asserts every series the controller registers carries (1) non-empty help
-text, (2) the `inferno_` name prefix, and (3) a unit suffix from the
-house convention — the three properties docs/observability.md relies on
-to stay a complete, readable catalogue. Runs as a CLI (wired into the
-Makefile) and from tests/test_metrics_lint.py, both against the same
-registry construction the production entry point uses.
+text that (2) does more than restate the metric name, (3) the `inferno_`
+name prefix, (4) a unit suffix from the house convention, and (5) only
+lower_snake_case label names on sampled series — the properties
+docs/observability.md relies on to stay a complete, readable catalogue.
+Runs as a CLI (wired into the Makefile) and from
+tests/test_metrics_lint.py, both against the same registry construction
+the production entry point uses. Its source-code sibling is the
+invariant analyzer (`make lint-invariants`, docs/analysis.md).
 """
 
 from __future__ import annotations
 
 import math
+import re
 import sys
 
 METRIC_NAME_PREFIX = "inferno_"
+
+# Prometheus-conventional label names: lower_snake_case, no leading
+# digit/underscore ("le" is the histogram bucket label and passes).
+LABEL_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _normalize(text: str) -> str:
+    """Case/punctuation-insensitive comparison form for the
+    help-duplicates-name rule: 'Inferno_Cycle-Dirty lanes  total' and
+    'inferno_cycle_dirty_lanes_total' normalize identically."""
+    return re.sub(r"[^a-z0-9]+", " ", text.lower()).strip()
 
 # Unit-suffix convention: every series name ends in the unit it is
 # measured in. `_total` marks counters (unitless cumulative counts),
@@ -56,6 +71,18 @@ def lint_registry(registry) -> list[str]:
                 f"{name} ({kind}): missing a unit suffix "
                 f"({'|'.join(UNIT_SUFFIXES)}) and not allowlisted"
             )
+        # help must DESCRIBE the series, not restate its name (ISSUE-15):
+        # a dashboard tooltip reading "inferno cycle dirty lanes total"
+        # under inferno_cycle_dirty_lanes_total documents nothing
+        norm_help = _normalize(help_)
+        if norm_help and norm_help in (
+            _normalize(name),
+            _normalize(name.removeprefix(METRIC_NAME_PREFIX)),
+        ):
+            violations.append(
+                f"{name} ({kind}): help text merely restates the metric "
+                f"name; describe what the series measures"
+            )
     # histogram bucket sanity (ISSUE-12): boundaries must be strictly
     # increasing and finite. The registry constructor only rejects
     # unsorted/empty tuples — duplicates and infinities pass it, and
@@ -74,6 +101,22 @@ def lint_registry(registry) -> list[str]:
                 f"{name} (histogram): bucket boundaries not strictly "
                 f"increasing: {tuple(buckets)}"
             )
+    # label-name convention (ISSUE-15): every label key on a live sample
+    # is lower_snake_case, so PromQL selectors stay guessable and the
+    # grouped-collection regex joins (`by (model_label, namespace)`)
+    # never quote-escape. Checked over sampled labelsets — the catalog
+    # itself is label-free, so the suite emits representative samples.
+    flagged: set[tuple[str, str]] = set()
+    for name, labelsets in getattr(registry, "labelsets", lambda: [])():
+        for labels in labelsets:
+            for key in labels:
+                if key != "le" and not LABEL_NAME_RE.match(key) and (
+                    name, key
+                ) not in flagged:
+                    flagged.add((name, key))
+                    violations.append(
+                        f"{name}: label name {key!r} is not lower_snake_case"
+                    )
     return violations
 
 

@@ -200,11 +200,9 @@ def _memoized_plan(kind: str, key: tuple, build):
 
 
 def _snapshot_enabled() -> bool:
-    import os
+    from inferno_tpu.config.defaults import env_flag
 
-    return os.environ.get("FLEET_SNAPSHOT", "true").lower() not in (
-        "0", "false", "no", "off",
-    )
+    return env_flag("FLEET_SNAPSHOT", True)
 
 
 _snapshot = None  # lazily-created module singleton (parallel.snapshot)
@@ -925,11 +923,9 @@ class FleetCandidates:
 
 
 def _incremental_enabled() -> bool:
-    import os
+    from inferno_tpu.config.defaults import env_flag
 
-    return os.environ.get("INCREMENTAL_CYCLE", "true").lower() not in (
-        "0", "false", "no", "off",
-    )
+    return env_flag("INCREMENTAL_CYCLE", True)
 
 
 _env_mesh_cache: list = [None, None]  # (env value, mesh) — identity-stable
@@ -939,9 +935,9 @@ def _env_mesh() -> jax.sharding.Mesh | None:
     """SIZING_SHARDS env → a cached 1-D fleet mesh over that many
     devices (capped at what jax has); unset/0/1 = no mesh. Cached so the
     solve memo's mesh-identity check keeps holding across cycles."""
-    import os
+    from inferno_tpu.config.defaults import env_str
 
-    raw = os.environ.get("SIZING_SHARDS", "").strip()
+    raw = env_str("SIZING_SHARDS").strip()
     if not raw:
         return None
     try:
@@ -1302,10 +1298,9 @@ def _batch_chunk_steps(requested: int | None, n_lanes: int) -> int:
     that's a ~100 MB peak regardless of fleet size OR ensemble seed
     count (a 200-seed ensemble runs more chunks, never bigger ones)."""
     if requested is None:
-        import os
+        from inferno_tpu.config.defaults import env_int
 
-        env = os.environ.get("PLANNER_CHUNK_STEPS", "").strip()
-        requested = int(env) if env else 0
+        requested = env_int("PLANNER_CHUNK_STEPS", 0)
     if requested > 0:
         return requested
     return max(1, 2_000_000 // max(n_lanes, 1))

@@ -34,12 +34,11 @@ plans and scalar<->vectorized allocations across the edge lanes
 from __future__ import annotations
 
 import itertools
-import os
 from operator import attrgetter
 
 import numpy as np
 
-from inferno_tpu.config.defaults import MAX_QUEUE_TO_BATCH_RATIO
+from inferno_tpu.config.defaults import MAX_QUEUE_TO_BATCH_RATIO, env_int
 
 # -- incremental dirty-scan codes (ISSUE-13) ----------------------------------
 # Per-server verdicts of `FleetSnapshot.scan_update`, ordered by how much
@@ -57,10 +56,10 @@ SCAN_CLEAN, SCAN_VALUE, SCAN_RATE, SCAN_FULL = 0, 1, 2, 3
 # Above this many servers the per-cycle scan switches from full
 # value-signature fidelity to identity witnesses + a rotating deep
 # verification (see scan_update's docstring for the exact contract).
-SCAN_FULL_SIG_LIMIT = int(os.environ.get("INCREMENTAL_FULL_SIG_LIMIT", "4096"))
+SCAN_FULL_SIG_LIMIT = env_int("INCREMENTAL_FULL_SIG_LIMIT", 4096)
 # Rotating-verification window: at identity-witness scale every server's
 # value signature is re-verified once per this many cycles.
-SCAN_VERIFY_CYCLES = max(int(os.environ.get("INCREMENTAL_VERIFY_CYCLES", "64")), 1)
+SCAN_VERIFY_CYCLES = max(env_int("INCREMENTAL_VERIFY_CYCLES", 64), 1)
 
 _GET_LOAD = attrgetter("load")
 _GET_ARRIVAL = attrgetter("arrival_rate")
@@ -407,7 +406,7 @@ class FleetSnapshot:
             acc_rank = {n: i for i, n in enumerate(sorted(system.accelerators))}
             for name, server in changed:
                 self._derive_server(system, name, server, acc_rank)
-            for stale in set(self._agg.frags) - set(names):
+            for stale in sorted(set(self._agg.frags) - set(names)):
                 for kind in (self._agg, self._tan):
                     kind.frags.pop(stale, None)
                     kind.lane_frags.pop(stale, None)

@@ -40,9 +40,9 @@ _BACKENDS = ("auto", "jax", "tpu", "tpu-pallas", "native")
 def _resolve_backend(requested: str) -> str:
     if requested != "auto":
         return requested
-    import os
+    from inferno_tpu.config.defaults import env_str
 
-    env = os.environ.get("PLANNER_BACKEND", "").strip()
+    env = env_str("PLANNER_BACKEND").strip()
     if env and env != "auto":
         # the env route must fail as fast as the validated CLI flag — an
         # unknown string would otherwise silently run as plain jax while
@@ -131,9 +131,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.seeds is None:
-        import os
+        from inferno_tpu.config.defaults import env_str
 
-        env = os.environ.get("PLANNER_SEEDS", "").strip()
+        env = env_str("PLANNER_SEEDS").strip()
         try:
             args.seeds = int(env) if env else 0
         except ValueError:

@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import heapq
 import math
-import os
 
 import numpy as np
 
@@ -61,9 +60,9 @@ from inferno_tpu.solver.greedy import (
 
 
 def _vec_enabled() -> bool:
-    return os.environ.get("GREEDY_VECTORIZED", "true").lower() not in (
-        "0", "false", "no", "off",
-    )
+    from inferno_tpu.config.defaults import env_flag
+
+    return env_flag("GREEDY_VECTORIZED", True)
 
 
 class _ArrayLedger:

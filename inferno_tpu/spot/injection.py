@@ -138,7 +138,9 @@ def storm_scenario(
 
     lam = sustainable_rate_rps(profile)
     rng = np.random.default_rng(seed)
-    times = np.sort(rng.uniform(0.1 * duration_s, 0.9 * duration_s, storms))
+    times = np.sort(
+        rng.uniform(0.1 * duration_s, 0.9 * duration_s, storms), kind="stable"
+    )
     fracs = rng.uniform(*fraction, storms)
     return SpotStormScenario(
         name=f"spot-storm-seed{seed}",

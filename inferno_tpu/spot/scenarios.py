@@ -226,7 +226,7 @@ def evaluate_storms(
     # [ranks] boolean per pool name, indexed by the winner rank matrix
     pool_mask = {
         pool: np.asarray([p == pool for p in pools], bool)
-        for pool in set(pools)
+        for pool in sorted(set(pools))
     }
 
     # chips each pool's tier carries per step, and the headroom the

@@ -395,7 +395,9 @@ def test_env_knobs_documented_in_user_guide():
     import inferno_tpu.controller as C
 
     pkg_dir = os.path.dirname(C.__file__)
-    pattern = r'(?:env_bool|os\.environ\.get)\(\s*"([A-Z][A-Z0-9_]+)"'
+    # the typed config/defaults.py accessors are the env-read seam
+    # (ISSUE-15): the first literal argument IS the knob name
+    pattern = r'(?:env_bool|env_flag|env_str|env_int|env_float|os\.environ\.get)\(\s*\n?\s*"([A-Z][A-Z0-9_]+)"'
     knobs = set()
     for path in glob.glob(os.path.join(pkg_dir, "*.py")):
         with open(path) as f:

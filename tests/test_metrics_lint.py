@@ -202,5 +202,59 @@ def test_every_production_histogram_has_sane_buckets():
     assert lint_registry(registry) == []
 
 
+def test_lint_flags_help_restating_name():
+    """ISSUE-15 satellite: help text that merely repeats the metric name
+    (any casing/punctuation, with or without the inferno_ prefix)
+    documents nothing and fails the lint."""
+    registry = Registry()
+    registry.gauge("inferno_queue_depth_ratio", "inferno_queue_depth_ratio")
+    registry.counter("inferno_evictions_total", "Evictions, total.")
+    registry.gauge("inferno_good_ms", "Wall time of the solve phase")
+    violations = lint_registry(registry)
+    assert len(violations) == 2
+    assert any(
+        "inferno_queue_depth_ratio" in v and "restates" in v for v in violations
+    )
+    assert any(
+        "inferno_evictions_total" in v and "restates" in v for v in violations
+    )
+    assert not any("inferno_good_ms" in v for v in violations)
+
+
+def test_lint_flags_non_snake_case_labels():
+    """ISSUE-15 satellite: label names on live samples must be
+    lower_snake_case (the `le` histogram label is synthesized and
+    exempt). The rule reads Registry.labelsets(), so it sees exactly
+    what /metrics would render."""
+    registry = Registry()
+    g = registry.gauge("inferno_styled_ratio", "per-variant style check")
+    g.set({"variant_name": "a", "modelLabel": "m"}, 1.0)
+    g.set({"variant_name": "b"}, 2.0)
+    h = registry.histogram("inferno_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe({"Phase": "solve"}, 0.2)
+    violations = lint_registry(registry)
+    assert len(violations) == 2
+    assert any(
+        "inferno_styled_ratio" in v and "'modelLabel'" in v for v in violations
+    )
+    assert any("inferno_lat_seconds" in v and "'Phase'" in v for v in violations)
+    # repeated samples with the same bad label stay ONE violation
+    g.set({"variant_name": "c", "modelLabel": "m2"}, 3.0)
+    assert len(lint_registry(registry)) == 2
+
+
+def test_production_samples_pass_label_lint():
+    """Representative production emissions (the actuation gauges carry
+    the richest label sets) sample cleanly under the label rule."""
+    from inferno_tpu.controller.metrics import MetricsEmitter
+
+    registry = Registry()
+    emitter = MetricsEmitter(registry)
+    emitter.emit_replica_metrics(
+        namespace="ns", variant="v", accelerator="v5e-4", current=2, desired=3
+    )
+    assert lint_registry(registry) == []
+
+
 def test_lint_cli_exit_code():
     assert main() == 0
