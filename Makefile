@@ -8,6 +8,7 @@ CLUSTER ?= inferno-tpu
 .PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
         bench-sizing bench-capacity bench-planner bench-montecarlo \
         bench-recorder bench-spot bench-profile bench-incremental \
+        bench-twin \
         perf-gate native lint lint-compile lint-metrics lint-invariants \
         manifests-sync docker-build deploy-kind deploy undeploy clean
 
@@ -100,6 +101,14 @@ bench-profile:
 # bench_full.json
 bench-incremental:
 	$(PYTHON) bench.py --incremental
+
+# Vectorized fleet-twin benchmark (ISSUE-19): 1000 emulated engines
+# through the canonical ramp+burst in ONE event loop vs the serial
+# scalar-engine oracle; >=10x speedup, bit-identical TTFT/latency
+# parity, and the reactive-vs-predictive closed-loop A/B ALL asserted
+# in the bench; recorded in bench_full.json
+bench-twin:
+	$(PYTHON) bench.py --twin
 
 # Perf-regression gate (ISSUE-12, CI): run the fast bench points
 # (--quick --profile), then diff the freshly-measured candidate

@@ -8,11 +8,14 @@ This rule bans `time.time()/monotonic()/perf_counter()/..._ns()` and
 designated seams, which own the clock and hand it out injectably:
 
   - obs/trace.py      the Tracer's span clock (constructor-injectable)
-  - emulator/engine.py, emulator/disagg.py
-                      the virtual-clock plumbing itself (the emulated
-                      engines derive their discrete-event clock from
-                      wall time by design; everything downstream reads
-                      the EMULATED clock)
+  - emulator/disagg.py
+                      the tandem engine's virtual-clock plumbing (it
+                      derives its discrete-event clock from wall time by
+                      design; everything downstream reads the EMULATED
+                      clock). emulator/engine.py graduated OUT of the
+                      seam set (ISSUE-19): its wall source is now the
+                      constructor-injected `clock` and the sync-stepped
+                      oracle mode never consults it.
 
 Everything else either takes a clock (Reconciler.clock, the forecaster
 and stabilizer timestamps, LoadGenerator pacing) or is grandfathered
@@ -30,7 +33,6 @@ RULE = "INF005"
 SEAM_FILES = frozenset(
     {
         "inferno_tpu/obs/trace.py",
-        "inferno_tpu/emulator/engine.py",
         "inferno_tpu/emulator/disagg.py",
     }
 )

@@ -750,6 +750,55 @@ class ProfilerInstruments:
             self.mem_peak.set({}, 0.0)
 
 
+# -- fleet-twin series (ISSUE-19) ---------------------------------------------
+
+METRIC_TWIN_EVENTS = "inferno_twin_events_total"
+METRIC_TWIN_ADVANCE_MS = "inferno_twin_advance_ms"
+METRIC_TWIN_ENGINES = "inferno_twin_engines_replicas"
+LABEL_POLICY = "policy"
+
+
+class TwinInstruments:
+    """Prometheus surface of the vectorized fleet twin (twin/plant.py):
+    decode-round events executed, virtual milliseconds advanced, and the
+    emulated pool size, labelled by the closed-loop policy driving the
+    plant. Registered unconditionally, like every other instrument
+    block, so the metric catalog is independent of whether a twin run is
+    in progress — a controller that never hosts a twin just exports the
+    series at zero."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self.events = self.registry.counter(
+            METRIC_TWIN_EVENTS,
+            "Decode-round engine-step events executed by the fleet twin "
+            "(one per engine per vectorized round it participated in)",
+        )
+        self.advance_ms = self.registry.counter(
+            METRIC_TWIN_ADVANCE_MS,
+            "Virtual (emulated-clock) milliseconds the twin plant has "
+            "been advanced through",
+        )
+        self.engines = self.registry.gauge(
+            METRIC_TWIN_ENGINES,
+            "Emulated engines in the twin plant's pool (allocated "
+            "columns, enabled or not)",
+        )
+
+    def observe_plant(self, plant, policy: str = "") -> None:
+        """Publish one twin plant's cumulative progress. Counters are
+        monotone in the plant's own cumulative totals, so call this
+        after each advance_to with the same plant/policy pair."""
+        labels = {LABEL_POLICY: policy} if policy else {}
+        delta = float(plant.events_total) - (self.events.get(labels) or 0.0)
+        if delta > 0:
+            self.events.inc(labels, delta)
+        delta_ms = float(plant.now_ms) - (self.advance_ms.get(labels) or 0.0)
+        if delta_ms > 0:
+            self.advance_ms.inc(labels, delta_ms)
+        self.engines.set(labels, float(plant.engines))
+
+
 class TLSConfig:
     """Serve-side TLS with cert reload (the reference uses certwatchers on
     its metrics endpoint, cmd/main.go:122-199). Certs are re-read when the

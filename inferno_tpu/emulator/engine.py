@@ -21,6 +21,7 @@ import heapq
 import threading
 import time
 from collections import deque
+from collections.abc import Callable
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,10 +104,22 @@ def wait_for_result(
 class EmulatedEngine:
     """One emulated replica, running its decode loop on a thread."""
 
-    def __init__(self, profile: EngineProfile, time_scale: float = 1.0):
-        """time_scale < 1 runs faster than real time (0.01 => 100x)."""
+    def __init__(
+        self,
+        profile: EngineProfile,
+        time_scale: float = 1.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        """time_scale < 1 runs faster than real time (0.01 => 100x).
+
+        `clock` is the wall-clock source (INF005 seam): the default-arg
+        REFERENCE keeps the engine honest under the invariant analyzer
+        (no wall-clock Call sites), and tests/the fleet twin inject a
+        virtual clock so runs are deterministic.
+        """
         self.profile = profile
         self.time_scale = time_scale
+        self._clock = clock
         self.waiting: deque[_Request] = deque()
         # keyed by id(request): completion removal must be O(1), not a
         # list scan — at SLO-sized batches roughly one request completes
@@ -128,8 +141,8 @@ class EmulatedEngine:
         self.arrivals: deque[float] = deque(maxlen=100_000)
         self.completions: deque[tuple[float, RequestResult]] = deque(maxlen=100_000)
         self.emu_ms = 0.0  # virtual clock: emulated msec since start
-        self._last_tick_wall = time.time()  # wall time of the last clock advance
-        self.started_at = time.time()
+        self._last_tick_wall = self._clock()  # wall time of the last clock advance
+        self.started_at = self._clock()
         # spot-eviction state (spot/injection.py): a preempted replica is
         # gone — loop stopped, in-flight work failed, submissions refused
         self.preempted = False
@@ -139,7 +152,7 @@ class EmulatedEngine:
     # -- public API ---------------------------------------------------------
 
     def start(self) -> None:
-        self.started_at = time.time()
+        self.started_at = self._clock()
         self.thread.start()
 
     def stop(self) -> None:
@@ -172,7 +185,7 @@ class EmulatedEngine:
         return len(victims)
 
     def submit(self, in_tokens: int, out_tokens: int) -> _Request:
-        req = _Request(in_tokens=in_tokens, out_tokens=max(out_tokens, 1), arrived=time.time())
+        req = _Request(in_tokens=in_tokens, out_tokens=max(out_tokens, 1), arrived=self._clock())
         if req.in_tokens + req.out_tokens > self.profile.kv_tokens_capacity:
             # can never be admitted: reject instead of queueing forever
             req.rejected = True
@@ -189,11 +202,45 @@ class EmulatedEngine:
                 req.rejected = True
                 req.done_event.set()
                 return req
-            elapsed = time.time() - self._last_tick_wall
+            elapsed = self._clock() - self._last_tick_wall
             req.arrived_emu = self.emu_ms + elapsed * 1000.0 / max(self.time_scale, 1e-9)
             self.waiting.append(req)
             self.arrivals.append(req.arrived)
         return req
+
+    def submit_at(self, in_tokens: int, out_tokens: int, at_emu_ms: float) -> _Request:
+        """Deterministic submission at an exact virtual instant — the
+        sync-stepped oracle mode the fleet twin's parity contract drives
+        (twin/oracle.py). Unlike `submit` there is no wall-clock
+        extrapolation: `arrived_emu` IS the given instant and the
+        wall-side stamp is derived from it, so identical seeds give
+        bit-identical results however loaded the host is."""
+        req = _Request(
+            in_tokens=in_tokens,
+            out_tokens=max(out_tokens, 1),
+            arrived=self.started_at + at_emu_ms * self.time_scale / 1000.0,
+        )
+        req.arrived_emu = at_emu_ms
+        if req.in_tokens + req.out_tokens > self.profile.kv_tokens_capacity:
+            req.rejected = True
+            req.done_event.set()
+            return req
+        with self.lock:
+            if self.preempted:
+                req.rejected = True
+                req.done_event.set()
+                return req
+            self.waiting.append(req)
+            self.arrivals.append(req.arrived)
+        return req
+
+    def advance_idle_to(self, emu_ms: float) -> None:
+        """Jump the virtual clock forward across an idle gap (sync
+        stepping only; the threaded loop tracks wall time instead).
+        A no-op when the target is in the past."""
+        with self.lock:
+            if emu_ms > self.emu_ms:
+                self.emu_ms = emu_ms
 
     def generate(self, in_tokens: int, out_tokens: int, timeout: float = 60.0) -> RequestResult | None:
         """Submit and block until completion (the /v1/chat path)."""
@@ -271,7 +318,7 @@ class EmulatedEngine:
                     # flake on loaded hosts).
                     if nxt.arrived_emu > self.emu_ms:
                         self.emu_ms = nxt.arrived_emu
-                        self._last_tick_wall = time.time()
+                        self._last_tick_wall = self._clock()
                 nxt.admit_step = self._step_index
                 self.running[id(nxt)] = nxt
                 self._new.append(nxt)
@@ -284,8 +331,94 @@ class EmulatedEngine:
                     (self._step_index + nxt.out_tokens, self._heap_seq, nxt),
                 )
 
-    def _loop(self) -> None:
+    def _step_cost(self, batch: int, new: list[_Request]) -> float:
+        """Emulated msec of one iteration: a decode step, plus the newly
+        admitted requests' prefill chunks riding it. The chunk SHARES the
+        iteration's weight pass (the architecture the on-chip mixed
+        kernel measures — llama_block.make_mixed_fn: projections
+        computed once for decode rows + chunk), so its marginal
+        cost is the per-token slope delta times the chunk tokens.
+        gamma (the fixed prefill cost, dominated by the weight
+        read) is charged only when there is NO decode iteration to
+        share with (engine idle -> pure prefill iteration). The
+        previous surcharge gamma + delta*in*batch misread the
+        TTFT-vs-B SIZING form as a physical per-chunk cost and
+        triple-counted prefill interference at high occupancy,
+        making SLO-sized operating points (B ~ 200+) falsely
+        unstable under emulation."""
         p = self.profile
+        step_ms = p.alpha + p.beta * batch + p.beta2 * batch * batch
+        if new:
+            step_ms += p.delta * sum(r.in_tokens for r in new)
+            if len(new) == batch:  # no in-flight decode to share
+                step_ms += p.gamma
+        return step_ms
+
+    def _apply_step(self, new: list[_Request], step_ms: float, now: float) -> list[_Request]:
+        """Advance the virtual clock one iteration and settle its stamps
+        and completions — shared verbatim by the threaded loop and the
+        sync-stepped oracle mode so their semantics cannot drift.
+        Returns the finished requests; the CALLER sets their done events
+        (outside the lock)."""
+        finished: list[_Request] = []
+        with self.lock:
+            self.emu_ms += step_ms
+            self._last_tick_wall = now
+            self._step_index += 1
+            emu_now = self.emu_ms
+            for r in new:
+                r.prefilled = True
+                r.first_token_at = now
+                r.first_token_emu = max(emu_now, r.arrived_emu)
+            heap = self._finish_heap
+            while heap and heap[0][0] <= self._step_index:
+                _, _, r = heapq.heappop(heap)
+                r.tokens_done = r.out_tokens
+                r.finished_at = now
+                r.finished_emu = max(emu_now, r.first_token_emu)
+                finished.append(r)
+                del self.running[id(r)]
+                self._kv_reserved -= r.in_tokens + r.out_tokens
+                self.completions.append(
+                    (
+                        now,
+                        RequestResult(
+                            ttft_ms=(r.first_token_at - r.arrived) * 1000.0,
+                            latency_ms=(now - r.arrived) * 1000.0,
+                            in_tokens=r.in_tokens,
+                            out_tokens=r.out_tokens,
+                            ttft_emu_ms=r.first_token_emu - r.arrived_emu,
+                            latency_emu_ms=emu_now - r.arrived_emu,
+                        ),
+                    )
+                )
+        return finished
+
+    def step_sync(self) -> float:
+        """Advance ONE decode iteration synchronously on the virtual
+        clock — no thread, no sleeps, no wall reads that matter. Admits
+        whatever is admissible, charges the same `_step_cost`, settles
+        via the same `_apply_step` as the threaded loop. Returns the
+        emulated msec consumed; 0.0 means idle (nothing waiting that can
+        be admitted and nothing running) and the caller should jump the
+        clock to the next arrival with `advance_idle_to`."""
+        self._admit()
+        with self.lock:
+            batch = len(self.running)
+            new = self._new
+            self._new = []
+        if batch == 0:
+            return 0.0
+        step_ms = self._step_cost(batch, new)
+        # derive the wall stamp FROM the virtual clock so wall-side
+        # results are an exact rescale of the emulated ones
+        now = self.started_at + (self.emu_ms + step_ms) * self.time_scale / 1000.0
+        finished = self._apply_step(new, step_ms, now)
+        for r in finished:
+            r.done_event.set()
+        return step_ms
+
+    def _loop(self) -> None:
         while not self.stop_flag:
             self._admit()
             with self.lock:
@@ -295,64 +428,14 @@ class EmulatedEngine:
             if batch == 0:
                 # idle: keep the virtual clock tracking wall time so
                 # arrival timestamps stay meaningful across quiet gaps
-                t0 = time.time()
+                t0 = self._clock()
                 time.sleep(0.0005)
                 with self.lock:
-                    self.emu_ms += (time.time() - t0) * 1000.0 / max(self.time_scale, 1e-9)
-                    self._last_tick_wall = time.time()
+                    self.emu_ms += (self._clock() - t0) * 1000.0 / max(self.time_scale, 1e-9)
+                    self._last_tick_wall = self._clock()
                 continue
-            # One iteration: a decode step, plus the newly admitted
-            # requests' prefill chunks riding it. The chunk SHARES the
-            # iteration's weight pass (the architecture the on-chip mixed
-            # kernel measures — llama_block.make_mixed_fn: projections
-            # computed once for decode rows + chunk), so its marginal
-            # cost is the per-token slope delta times the chunk tokens.
-            # gamma (the fixed prefill cost, dominated by the weight
-            # read) is charged only when there is NO decode iteration to
-            # share with (engine idle -> pure prefill iteration). The
-            # previous surcharge gamma + delta*in*batch misread the
-            # TTFT-vs-B SIZING form as a physical per-chunk cost and
-            # triple-counted prefill interference at high occupancy,
-            # making SLO-sized operating points (B ~ 200+) falsely
-            # unstable under emulation.
-            step_ms = p.alpha + p.beta * batch + p.beta2 * batch * batch
-            if new:
-                step_ms += p.delta * sum(r.in_tokens for r in new)
-                if len(new) == batch:  # no in-flight decode to share
-                    step_ms += p.gamma
+            step_ms = self._step_cost(batch, new)
             time.sleep(step_ms / 1000.0 * self.time_scale)
-            now = time.time()
-            finished: list[_Request] = []
-            with self.lock:
-                self.emu_ms += step_ms
-                self._last_tick_wall = now
-                self._step_index += 1
-                emu_now = self.emu_ms
-                for r in new:
-                    r.prefilled = True
-                    r.first_token_at = now
-                    r.first_token_emu = max(emu_now, r.arrived_emu)
-                heap = self._finish_heap
-                while heap and heap[0][0] <= self._step_index:
-                    _, _, r = heapq.heappop(heap)
-                    r.tokens_done = r.out_tokens
-                    r.finished_at = now
-                    r.finished_emu = max(emu_now, r.first_token_emu)
-                    finished.append(r)
-                    del self.running[id(r)]
-                    self._kv_reserved -= r.in_tokens + r.out_tokens
-                    self.completions.append(
-                        (
-                            now,
-                            RequestResult(
-                                ttft_ms=(r.first_token_at - r.arrived) * 1000.0,
-                                latency_ms=(now - r.arrived) * 1000.0,
-                                in_tokens=r.in_tokens,
-                                out_tokens=r.out_tokens,
-                                ttft_emu_ms=r.first_token_emu - r.arrived_emu,
-                                latency_emu_ms=emu_now - r.arrived_emu,
-                            ),
-                        )
-                    )
+            finished = self._apply_step(new, step_ms, self._clock())
             for r in finished:
                 r.done_event.set()

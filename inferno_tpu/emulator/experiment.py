@@ -24,6 +24,7 @@ import statistics
 import sys
 import threading
 import time
+from collections.abc import Callable
 from typing import Any
 
 from inferno_tpu.emulator.disagg import DisaggEngine, DisaggProfile
@@ -184,9 +185,15 @@ def _model_prediction(scenario: Scenario, per_replica_rps: float) -> dict[str, A
     }
 
 
-def run_scenario(scenario: Scenario) -> dict[str, Any]:
+def run_scenario(
+    scenario: Scenario, clock: Callable[[], float] = time.time
+) -> dict[str, Any]:
     """Run every repetition of one scenario and aggregate
-    (reference: the per-variation NUM_RUNS loop, experiment.py)."""
+    (reference: the per-variation NUM_RUNS loop, experiment.py).
+
+    `clock` (INF005 seam) only paces the drain deadline — a wall bound
+    on waiting for in-flight work, injected so the analyzer's
+    no-wall-reads rule holds without an allowlist entry."""
     if scenario.emu_paced and (scenario.replicas != 1 or scenario.disagg is not None):
         # the schedule clock is engines[0]'s virtual clock: with N
         # replicas the realized "per-replica" rate would silently read
@@ -265,8 +272,8 @@ def run_scenario(scenario: Scenario) -> dict[str, Any]:
             stats.submitted = gen.submitted
             # drain: wait for in-flight work to finish
             with tracer.span("drain"):
-                deadline = time.time() + 30.0
-                while time.time() < deadline and any(
+                deadline = clock() + 30.0
+                while clock() < deadline and any(
                     e.num_running or e.num_waiting for e in engines
                 ):
                     time.sleep(0.02)

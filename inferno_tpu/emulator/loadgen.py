@@ -10,6 +10,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+from collections.abc import Callable
 
 import numpy as np
 
@@ -107,6 +108,7 @@ class LoadGenerator:
         out_dist: TokenDistribution | None = None,
         schedule_clock=None,
         wall_per_unit: float = 1.0,
+        wall_clock: Callable[[], float] = time.time,
     ):
         """`schedule_clock` (optional) makes the arrival schedule run on a
         caller-supplied clock instead of wall time: a zero-arg callable
@@ -116,7 +118,9 @@ class LoadGenerator:
         realized emulated rate tracks the schedule by construction, with
         no wall-overhead distortion (the bench's benched-point runs use
         this). `wall_per_unit` estimates wall seconds per schedule second
-        (the engine's time_scale) so waits sleep instead of spinning."""
+        (the engine's time_scale) so waits sleep instead of spinning.
+        `wall_clock` is the wall source behind the default schedule
+        clock (INF005 seam: a default-arg reference, injectable)."""
         self.engines = engines
         self.rate = rate
         self.in_tokens = in_tokens
@@ -128,6 +132,7 @@ class LoadGenerator:
         self.submitted = 0
         self.schedule_clock = schedule_clock
         self.wall_per_unit = wall_per_unit
+        self.wall_clock = wall_clock
         # schedule seconds actually elapsed when the run finished (~ the
         # schedule duration): the denominator for an unbiased realized
         # rate — engine-side clocks include thread-startup idle
@@ -137,8 +142,8 @@ class LoadGenerator:
     def _clock(self):
         """Elapsed schedule seconds since generator start."""
         if self.schedule_clock is None:
-            start = time.time()
-            return lambda: time.time() - start
+            start = self.wall_clock()
+            return lambda: self.wall_clock() - start
         c0 = self.schedule_clock()
         return lambda: self.schedule_clock() - c0
 

@@ -127,8 +127,9 @@ def build_controller_registry():
     (CycleInstruments), the predictive-scaling forecast gauges
     (ForecastInstruments), the SLO-attainment / model-error scoreboard
     gauges (AttainmentInstruments), the spot-market placement /
-    preemption series (SpotInstruments), and the cycle-profiler series
-    (ProfilerInstruments) — each registered unconditionally, like the
+    preemption series (SpotInstruments), the cycle-profiler series
+    (ProfilerInstruments), and the fleet-twin progress series
+    (TwinInstruments) — each registered unconditionally, like the
     Reconciler does, so the catalog is identical whatever features are
     enabled."""
     from inferno_tpu.controller.metrics import (
@@ -139,6 +140,7 @@ def build_controller_registry():
         ProfilerInstruments,
         Registry,
         SpotInstruments,
+        TwinInstruments,
     )
 
     registry = Registry()
@@ -148,6 +150,7 @@ def build_controller_registry():
     AttainmentInstruments(registry)
     SpotInstruments(registry)
     ProfilerInstruments(registry)
+    TwinInstruments(registry)
     return registry
 
 

@@ -207,6 +207,19 @@ def metrics_from_bench_full(doc: dict) -> dict[str, Metric]:
         out["incr_steady_ms"] = out["incremental_steady_ms"]
     if "incremental_cold_ms" in out:
         out["incr_cold_ms"] = out["incremental_cold_ms"]
+
+    # vectorized fleet twin (ISSUE-19, `make bench-twin`): the warm
+    # 1000-engine pass is the phase to watch, noise-banded by its
+    # recorded warm-repeat spread. twin_fleet_cold_ms is deliberately
+    # NOT gated (single unrepeated allocation-heavy measurement, same
+    # rationale as mc_cold_ms); oracle_serial_ms is a baseline, not a
+    # deliverable — a slower oracle is not a product regression.
+    twin = doc.get("twin") or {}
+    if _num(twin.get("twin_fleet_ms")) is not None:
+        out["twin_fleet_ms"] = Metric(
+            _num(twin.get("twin_fleet_ms")),
+            _num(twin.get("twin_fleet_ms_spread")) or 0.0,
+        )
     return out
 
 

@@ -1,0 +1,224 @@
+"""Request-level trace generators for the fleet twin.
+
+Fleet-scale arrival traces — each a sorted arrival-time column plus
+in/out token columns — covering the scenario diversity the single
+canonical plant could not: heavy-tailed token mixes, multi-turn/agentic
+sessions that re-arrive with grown context, and correlated flash crowds.
+Seeding follows the PR 8 fixed-generator-index convention
+(`planner.scenarios.derive_ensemble_seeds` over the `TRACES` table), so
+member 0 of any ensemble is exactly the single-replay trace for the same
+(name, seed) and no two (generator, member) pairs share a raw seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from inferno_tpu.emulator.loadgen import (
+    SHAREGPT_INPUT,
+    SHAREGPT_OUTPUT,
+    RateSpec,
+    TokenDistribution,
+)
+from inferno_tpu.planner.scenarios import derive_ensemble_seeds
+
+
+@dataclasses.dataclass(frozen=True)
+class TwinTrace:
+    """A fleet-level request trace: arrivals sorted nondecreasing."""
+
+    name: str
+    seed: int
+    duration_s: float
+    arr_ms: np.ndarray  # [N] float64, sorted
+    in_tokens: np.ndarray  # [N] int64
+    out_tokens: np.ndarray  # [N] int64
+
+    @property
+    def requests(self) -> int:
+        return len(self.arr_ms)
+
+    def offered_rps(self) -> float:
+        return self.requests / self.duration_s if self.duration_s else 0.0
+
+
+def _poisson_arrivals(
+    rng: np.random.Generator, rate: RateSpec, duration_s: float
+) -> np.ndarray:
+    """Nonhomogeneous Poisson arrivals (msec) over a piecewise schedule:
+    homogeneous exponential gaps within each phase, restarted at phase
+    edges — the same process `LoadGenerator` realizes serially."""
+    out: list[float] = []
+    t_edge = 0.0
+    for dur, rps in rate.phases:
+        end = min(t_edge + dur, duration_s)
+        t = t_edge
+        if rps > 0:
+            while True:
+                t += float(rng.exponential(1.0 / rps))
+                if t >= end:
+                    break
+                out.append(t * 1000.0)
+        t_edge = end
+        if t_edge >= duration_s:
+            break
+    return np.asarray(out, dtype=np.float64)
+
+
+def _tokens(
+    rng: np.random.Generator,
+    n: int,
+    in_dist: TokenDistribution,
+    out_dist: TokenDistribution,
+) -> tuple[np.ndarray, np.ndarray]:
+    i = np.array([in_dist.sample(rng) for _ in range(n)], dtype=np.int64)
+    o = np.array([out_dist.sample(rng) for _ in range(n)], dtype=np.int64)
+    return i, o
+
+
+def steady(rate_rps: float, duration_s: float, seed: int = 0) -> TwinTrace:
+    """Stationary Poisson traffic at the ShareGPT-ish token mix — the
+    parity workhorse (it exercises every admission path without shape
+    changes)."""
+    rng = np.random.default_rng(seed)
+    arr = _poisson_arrivals(rng, RateSpec(((duration_s, rate_rps),)), duration_s)
+    i, o = _tokens(rng, len(arr), SHAREGPT_INPUT, SHAREGPT_OUTPUT)
+    return TwinTrace("steady", seed, duration_s, arr, i, o)
+
+
+def ramp_burst(rate_rps: float, duration_s: float, seed: int = 0) -> TwinTrace:
+    """The canonical closed-loop shape (`forecast_scenario`): ramp
+    1.3x -> 5x, hold, a 9x burst, hold, ramp down, cheap tail — rates in
+    multiples of `rate_rps` and phase widths in fractions of
+    `duration_s`, so the same stress lands at any fleet scale."""
+    rng = np.random.default_rng(seed)
+    u = duration_s / 92.0  # the canonical schedule's 92 s, rescaled
+    up = RateSpec.ramp(1.3 * rate_rps, 5.0 * rate_rps, 30.0 * u, steps=6)
+    down = RateSpec.ramp(5.0 * rate_rps, 1.5 * rate_rps, 12.0 * u, steps=4)
+    schedule = RateSpec(
+        up.phases
+        + ((12.0 * u, 5.0 * rate_rps), (6.0 * u, 9.0 * rate_rps),
+           (12.0 * u, 5.0 * rate_rps))
+        + down.phases
+        + ((20.0 * u, 1.5 * rate_rps),)
+    )
+    arr = _poisson_arrivals(rng, schedule, duration_s)
+    i, o = _tokens(rng, len(arr), SHAREGPT_INPUT, SHAREGPT_OUTPUT)
+    return TwinTrace("ramp_burst", seed, duration_s, arr, i, o)
+
+
+def flash_crowd(
+    rate_rps: float, duration_s: float, seed: int = 0,
+    spikes: int = 3, spike_scale: float = 6.0,
+) -> TwinTrace:
+    """Correlated flash crowds: baseline Poisson plus `spikes` short
+    windows (5% of the horizon each) at `spike_scale`x the base rate,
+    at seeded random instants — the correlated-across-variants surge
+    `planner.scenarios.flash_crowd` models at trace granularity."""
+    rng = np.random.default_rng(seed)
+    width = 0.05 * duration_s
+    starts = np.sort(rng.uniform(0.0, duration_s - width, size=spikes))
+    phases: list[tuple[float, float]] = []
+    t = 0.0
+    for s in starts:
+        if s > t:
+            phases.append((s - t, rate_rps))
+        phases.append((width, spike_scale * rate_rps))
+        t = max(t, s) + width
+    if t < duration_s:
+        phases.append((duration_s - t, rate_rps))
+    arr = _poisson_arrivals(rng, RateSpec(tuple(phases)), duration_s)
+    i, o = _tokens(rng, len(arr), SHAREGPT_INPUT, SHAREGPT_OUTPUT)
+    return TwinTrace("flash_crowd", seed, duration_s, arr, i, o)
+
+
+def agentic(
+    rate_rps: float, duration_s: float, seed: int = 0,
+    mean_turns: float = 4.0, think_s: float = 2.0,
+) -> TwinTrace:
+    """Multi-turn/agentic sessions: session starts are Poisson at a rate
+    chosen so the TOTAL request rate averages `rate_rps`; each session
+    runs a geometric number of turns, every follow-up re-arriving after
+    a lognormal think gap WITH GROWN CONTEXT (the next prompt carries
+    the whole conversation: previous in + previous out + the new turn's
+    text) — the KV-pressure shape single-turn traces never produce."""
+    rng = np.random.default_rng(seed)
+    session_rate = rate_rps / max(mean_turns, 1.0)
+    starts = _poisson_arrivals(
+        rng, RateSpec(((duration_s, session_rate),)), duration_s
+    )
+    arr: list[float] = []
+    ins: list[int] = []
+    outs: list[int] = []
+    for s_ms in starts:
+        turns = 1 + int(rng.geometric(1.0 / max(mean_turns, 1.0)))
+        t = float(s_ms)
+        context = 0
+        for _ in range(turns):
+            text = SHAREGPT_INPUT.sample(rng)
+            out = SHAREGPT_OUTPUT.sample(rng)
+            i_tok = min(context + text, SHAREGPT_INPUT.max_tokens * 8)
+            if t >= duration_s * 1000.0:
+                break
+            arr.append(t)
+            ins.append(i_tok)
+            outs.append(out)
+            context = i_tok + out  # the follow-up carries it all
+            gap_s = float(rng.lognormal(np.log(think_s), 0.6))
+            t += gap_s * 1000.0
+    order = np.argsort(np.asarray(arr), kind="stable")
+    return TwinTrace(
+        "agentic", seed, duration_s,
+        np.asarray(arr, dtype=np.float64)[order],
+        np.asarray(ins, dtype=np.int64)[order],
+        np.asarray(outs, dtype=np.int64)[order],
+    )
+
+
+def heavy_tail(rate_rps: float, duration_s: float, seed: int = 0) -> TwinTrace:
+    """Poisson arrivals under a heavier-than-ShareGPT token mix (wider
+    lognormal sigma, taller caps): the long-context stragglers that
+    dominate KV occupancy and head-of-line block admission."""
+    rng = np.random.default_rng(seed)
+    arr = _poisson_arrivals(rng, RateSpec(((duration_s, rate_rps),)), duration_s)
+    i, o = _tokens(
+        rng, len(arr),
+        TokenDistribution(median=200.0, sigma=1.6, max_tokens=8192),
+        TokenDistribution(median=150.0, sigma=1.2, max_tokens=2048),
+    )
+    return TwinTrace("heavy_tail", seed, duration_s, arr, i, o)
+
+
+TRACES = {
+    "steady": steady,
+    "ramp_burst": ramp_burst,
+    "flash_crowd": flash_crowd,
+    "agentic": agentic,
+    "heavy_tail": heavy_tail,
+}
+
+
+def trace_ensemble_seeds(name: str, base_seed: int, count: int) -> list[int]:
+    """Seeds of a `count`-member ensemble of one twin trace generator —
+    `derive_ensemble_seeds` over TRACES, the same convention the traffic
+    and storm ensembles share."""
+    return derive_ensemble_seeds(TRACES, name, base_seed, count, what="trace")
+
+
+def build_trace(
+    name: str, rate_rps: float, duration_s: float, seed: int = 0
+) -> TwinTrace:
+    if name not in TRACES:
+        raise ValueError(f"unknown trace {name!r}; available: {sorted(TRACES)}")
+    member_seed = trace_ensemble_seeds(name, seed, 1)[0]
+    return TRACES[name](rate_rps, duration_s, seed=member_seed)
+
+
+def route_round_robin(
+    trace: TwinTrace, engines: int, start: int = 0
+) -> np.ndarray:
+    """Static round-robin request routing over `engines` — per-engine
+    arrival order stays nondecreasing because the trace is sorted."""
+    return (np.arange(trace.requests, dtype=np.int64) + start) % engines

@@ -388,13 +388,27 @@ def test_inf005_seam_files_are_exempt(tmp_path):
             "def now():\n"
             "    return time.perf_counter()\n"
         ),
-        "inferno_tpu/emulator/engine.py": (
+        "inferno_tpu/emulator/disagg.py": (
             "import time\n"
             "def virtual_base():\n"
             "    return time.monotonic()\n"
         ),
     })
     assert report.findings == []
+
+
+def test_inf005_engine_graduated_out_of_seam_set(tmp_path):
+    # ISSUE-19: emulator/engine.py takes its wall source via the
+    # constructor-injected `clock` now, so a raw read there must fire
+    # like anywhere else (the fleet twin's determinism depends on it)
+    report = analyze(tmp_path, {
+        "inferno_tpu/emulator/engine.py": (
+            "import time\n"
+            "def virtual_base():\n"
+            "    return time.monotonic()\n"
+        ),
+    })
+    assert [f.rule for f in report.findings] == ["INF005"]
 
 
 # -- escape hatches -----------------------------------------------------------

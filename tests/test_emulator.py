@@ -278,7 +278,11 @@ def test_e2e_p95_ttft_meets_raw_slo_under_poisson_load():
     the tail-aware analyzer, drive the emulated engine with Poisson load
     at that rate, and check the p95 of *measured* TTFT — not just the
     mean — beats the raw SLO. The reference defines the margin but never
-    applies it (/root/reference/pkg/core/allocation.go:117)."""
+    applies it (/root/reference/pkg/core/allocation.go:117).
+
+    Fast-tier port (ISSUE-19, deterministic virtual clock):
+    tests/test_twin.py::test_e2e_p95_ttft_meets_raw_slo_under_poisson_load_twin
+    """
     from inferno_tpu.analyzer import RequestSize, TargetPerf, build_analyzer
     from inferno_tpu.config.defaults import SLO_PERCENTILE
 

@@ -156,7 +156,11 @@ def test_closed_loop_matches_tandem_analyzer():
     sibling in test_disagg_simulation.py is slow for the same reason).
     The aggregated engine's closed loop (test_emulator.py) keeps the
     fast-tier modeled-vs-works coverage: its virtual clock is step-
-    accumulated, not wall-derived."""
+    accumulated, not wall-derived.
+
+    Fast-tier port (ISSUE-19, deterministic tandem DES):
+    tests/test_twin.py::test_closed_loop_matches_tandem_analyzer_twin
+    """
     decode = DecodeParms(alpha=40.0, beta=1.0)
     prefill = PrefillParms(gamma=30.0, delta=0.02)
     request = RequestSize(avg_in_tokens=128, avg_out_tokens=12)

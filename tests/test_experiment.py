@@ -80,6 +80,8 @@ def test_model_error_small_in_steady_state():
     # point exactly — under wall pacing at extreme compression the
     # realized emulated rate drifts with host overhead and the
     # "steady state" lands wherever the host was that day
+    # Fast-tier port (ISSUE-19, deterministic virtual clock):
+    # tests/test_twin.py::test_model_error_small_in_steady_state_twin
     res = run_scenario(_quick_scenario(
         emu_paced=True, rate=RateSpec(((6.0, 30.0),)), time_scale=0.01))
     assert "model_error" in res

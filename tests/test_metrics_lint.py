@@ -16,10 +16,11 @@ def test_production_catalog_is_clean():
     # the four attainment/model-error scoreboard gauges, the three
     # spot-market series (placement gauges + preemption counter), the
     # six cycle-profiler series (phase wall/CPU histograms, burn gauge,
-    # event + ms counters, memory high-water gauge), and the three
+    # event + ms counters, memory high-water gauge), the three
     # incremental dirty-set series (dirty-lane/skipped-server counters,
-    # per-variant dirty marker gauge)
-    assert len(names) == 31
+    # per-variant dirty marker gauge), and the three fleet-twin progress
+    # series (event counter, virtual-ms counter, pool-size gauge)
+    assert len(names) == 34
     assert {"inferno_desired_replicas", "inferno_cycle_duration_seconds",
             "inferno_variant_analysis_seconds", "inferno_solver_seconds",
             "inferno_prom_scrape_seconds"} <= names
@@ -89,6 +90,47 @@ def test_incremental_dirty_series_in_catalog():
     assert inst.dirty_ratio.get(
         {"namespace": "ns", "variant_name": "b"}
     ) == 0.0
+
+
+def test_twin_series_in_catalog():
+    """The ISSUE-19 fleet-twin progress series register unconditionally
+    (the catalog must not depend on whether a twin run is hosted), carry
+    unit suffixes, and the counters track a plant's cumulative totals
+    monotonically across repeated observations."""
+    from inferno_tpu.controller.metrics import TwinInstruments
+
+    registry = build_controller_registry()
+    catalog = {name: (help_, kind) for name, help_, kind in registry.catalog()}
+    expected = {
+        "inferno_twin_events_total": "counter",
+        "inferno_twin_advance_ms": "counter",
+        "inferno_twin_engines_replicas": "gauge",
+    }
+    for name, kind in expected.items():
+        assert name in catalog, name
+        help_, got_kind = catalog[name]
+        assert got_kind == kind
+        assert help_.strip()
+
+    class PlantStub:
+        engines = 8
+        events_total = 100
+        now_ms = 2000.0
+
+    inst = TwinInstruments(Registry())
+    inst.observe_plant(PlantStub(), policy="reactive")
+    labels = {"policy": "reactive"}
+    assert inst.events.get(labels) == 100.0
+    assert inst.advance_ms.get(labels) == 2000.0
+    assert inst.engines.get(labels) == 8.0
+    # re-observing the same cumulative state must not double-count
+    inst.observe_plant(PlantStub(), policy="reactive")
+    assert inst.events.get(labels) == 100.0
+    stub = PlantStub()
+    stub.events_total, stub.now_ms = 150, 3000.0
+    inst.observe_plant(stub, policy="reactive")
+    assert inst.events.get(labels) == 150.0
+    assert inst.advance_ms.get(labels) == 3000.0
 
 
 def test_lint_flags_missing_prefix_and_help():
