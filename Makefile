@@ -8,7 +8,7 @@ CLUSTER ?= inferno-tpu
 .PHONY: all test test-unit test-e2e test-apiserver bench bench-cycle \
         bench-sizing bench-capacity bench-planner bench-montecarlo \
         bench-recorder bench-spot bench-profile bench-incremental \
-        bench-twin \
+        bench-twin bench-event \
         perf-gate native lint lint-compile lint-metrics lint-invariants \
         manifests-sync docker-build deploy-kind deploy undeploy clean
 
@@ -109,6 +109,14 @@ bench-incremental:
 # in the bench; recorded in bench_full.json
 bench-twin:
 	$(PYTHON) bench.py --twin
+
+# Event-driven reconcile benchmark (ISSUE-20): 1M variants — p99
+# single-variant event->decision latency < 1 s on CPU, >=10x fewer
+# scanned+solved servers per cycle than the poll loop at 1% events,
+# event==poll decision-surface bit-parity; ALL asserted in the bench;
+# recorded in bench_full.json (the event block perf-gate diffs)
+bench-event:
+	$(PYTHON) bench.py --event
 
 # Perf-regression gate (ISSUE-12, CI): run the fast bench points
 # (--quick --profile), then diff the freshly-measured candidate

@@ -1645,6 +1645,346 @@ def incremental_cycle_bench(
     }
 
 
+def event_reconcile_bench(
+    n_variants: int = 1_000_000,
+    events_fraction: float = 0.01,
+    steady_cycles: int = 6,
+    warmup_cycles: int = 4,
+    single_events: int = 24,
+    backend: str | None = None,
+) -> dict:
+    """Event-driven million-variant reconcile (ISSUE-20).
+
+    One persistent fleet, two reconcile disciplines compared on the same
+    1%-events traffic (per cycle, `events_fraction` of variants' arrival
+    rates move):
+
+    * **event-driven** — movers are marked into the watch-fed
+      `DirtyQueue` (λ-delta source), the drained set feeds the
+      event-authoritative scan (`snapshot.scan_event_update`): only the
+      named servers are read, only their lanes solved.
+    * **poll loop** — the same traffic through the plain incremental
+      path: the O(fleet) signature scan classifies, dirty lanes solve.
+
+    Asserted at full (1M-variant) scale, reported at any scale:
+
+    * p99 single-variant event→decision latency < 1 s on CPU (latency =
+      mark → drain → targeted scan → solve; the reconciler's deliberate
+      debounce window is a policy constant, not compute, and is not
+      part of it);
+    * >= 10x fewer scanned+solved servers per cycle than the poll loop
+      (at 1% events the event path touches ~2% of the fleet, the poll
+      loop 100% scanned + ~1% solved);
+    * event ≡ poll decision-surface bit-parity — the final fleet's
+      decisions against an INCREMENTAL_CYCLE=0 full solve of the same
+      inputs, RAISES on divergence (same comparator and 1e-4
+      operating-point band as the ISSUE-13 incremental bench).
+
+    The event-storm point drives the correlated flash-crowd envelope
+    from `twin.traces.flash_envelope` (ISSUE-20 twin leftover): one
+    shared burst window scales EVERY variant's λ at once — the
+    storm-entry and storm-exit cycles are whole-fleet event cycles,
+    reported unasserted (they are all-rate refolds, bounded by the
+    ISSUE-13 all-rate budget discipline).
+    """
+    import gc
+    import os
+
+    import jax
+
+    from inferno_tpu.controller.watch import SOURCE_LAMBDA, DirtyQueue
+    from inferno_tpu.obs.profiler import CycleProfiler
+    from inferno_tpu.parallel import reset_fleet_state
+    from inferno_tpu.solver.solver import solve_unlimited
+    from inferno_tpu.testing.fleet import fleet_system_spec
+    from inferno_tpu.twin.traces import flash_envelope
+
+    if backend is None:
+        backend = "tpu" if jax.default_backend() == "tpu" else "jax"
+    assert_full_scale = n_variants >= 1_000_000
+
+    reset_fleet_state()
+    spec = fleet_system_spec(n_variants, shapes_per_variant=1)
+    system = System(spec)
+    calculate_fleet(system, backend=backend)  # jit + table + state warmup
+    solve_unlimited(system)
+
+    rng = np.random.default_rng(20)
+    names = list(system.servers)
+    servers = list(system.servers.values())
+
+    def perturb(idx) -> list[str]:
+        moved = []
+        for i in idx:
+            load = servers[i].load
+            if load is not None and load.arrival_rate > 0:
+                load.arrival_rate *= float(rng.uniform(0.8, 1.4))
+                moved.append(names[i])
+        return moved
+
+    eligible = [i for i, s in enumerate(servers)
+                if s.load is not None and s.load.arrival_rate > 0]
+
+    def pick(fraction: float):
+        return rng.choice(
+            len(servers), max(int(len(servers) * fraction), 1), replace=False
+        )
+
+    def pick_single():
+        # a single EVENT must be a real λ move: zero-load variants'
+        # perturbation is a no-op and would measure an empty cycle
+        return [int(rng.choice(eligible))]
+
+    # the bench's queue never runs the periodic anti-entropy full scan:
+    # that pass IS the poll loop measured below, and injecting one into
+    # the steady event loop would measure the schedule, not the path
+    queue = DirtyQueue(wake=None, debounce_s=0.0,
+                       anti_entropy_cycles=1_000_000_000)
+
+    def event_cycle(idx) -> tuple[float, int, int]:
+        moved = perturb(idx)
+        t0 = time.perf_counter()
+        queue.mark(moved, source=SOURCE_LAMBDA, wake=False)
+        dirty = queue.drain()
+        calculate_fleet(system, backend=backend, event_dirty=dirty)
+        solve_unlimited(system)
+        elapsed = (time.perf_counter() - t0) * 1000.0
+        fd = system.fleet_dirty
+        return (elapsed, int(fd.scanned_servers) if fd else len(servers),
+                int(len(fd.dirty_pos)) if fd else 0)
+
+    def poll_cycle(idx) -> tuple[float, int, int]:
+        perturb(idx)
+        t0 = time.perf_counter()
+        calculate_fleet(system, backend=backend)
+        solve_unlimited(system)
+        elapsed = (time.perf_counter() - t0) * 1000.0
+        fd = system.fleet_dirty
+        return (elapsed, int(fd.scanned_servers) if fd else len(servers),
+                int(len(fd.dirty_pos)) if fd else 0)
+
+    # warm the refold programs across the pad-shape band both the
+    # fraction-sized and the single-event dirty sets land in
+    for _ in range(warmup_cycles):
+        poll_cycle(pick(events_fraction))
+        event_cycle(pick(events_fraction))
+        event_cycle(pick_single())  # size-1 bucket (single-event latency)
+
+    gc.collect()
+    profiler_cls = CycleProfiler
+
+    def timed_loop(cycle_fn, cycles: int, fraction: float):
+        """min-of-warm loop with jit-compile filtering, GC quiesced —
+        the ISSUE-13 measurement discipline."""
+        all_ms, warm_ms = [], []
+        scanned = solved = 0
+        gc.disable()
+        try:
+            for _ in range(cycles):
+                idx = pick(fraction)
+                prof = profiler_cls().activate()
+                elapsed, scanned, solved = cycle_fn(idx)
+                prof.deactivate()
+                all_ms.append(elapsed)
+                if not prof.counters.get("jit_compiles"):
+                    warm_ms.append(elapsed)
+        finally:
+            gc.enable()
+        if not warm_ms:
+            warm_ms = all_ms
+        return all_ms, warm_ms, scanned, solved
+
+    ev_all, ev_warm, ev_scanned, ev_solved = timed_loop(
+        event_cycle, steady_cycles, events_fraction
+    )
+    event_steady_ms = min(ev_warm)
+    poll_all, poll_warm, poll_scanned, poll_solved = timed_loop(
+        poll_cycle, steady_cycles, events_fraction
+    )
+    poll_steady_ms = min(poll_warm)
+
+    # scanned+solved work per cycle: the event path's whole claim is
+    # that it touches O(dirty), not O(fleet)
+    event_work = ev_scanned + ev_solved
+    poll_work = poll_scanned + poll_solved
+    work_reduction = poll_work / max(event_work, 1)
+
+    # single-variant event -> decision latency, three batches for the
+    # perfdiff warm-repeat noise band. Same jit-compile filtering as the
+    # steady loops: a stray refold-bucket compile is a one-time cost per
+    # process, not the steady-state latency the budget bounds — with 24
+    # samples the p99 IS the max, so one unfiltered compile would report
+    # the compiler, not the path (counted in latency_compile_cycles).
+    batch_p99s = []
+    latencies: list[float] = []
+    latency_compiles = 0
+    gc.disable()
+    try:
+        for _ in range(3):
+            batch = []
+            for _ in range(max(single_events // 3, 2)):
+                prof = profiler_cls().activate()
+                lat, _, _ = event_cycle(pick_single())
+                prof.deactivate()
+                if prof.counters.get("jit_compiles"):
+                    latency_compiles += 1
+                    continue
+                batch.append(lat)
+            if batch:
+                batch_p99s.append(float(np.percentile(batch, 99)))
+            latencies.extend(batch)
+    finally:
+        gc.enable()
+    if not latencies:
+        raise AssertionError(
+            "every single-event latency cycle compiled: warmup failed to "
+            "cover the size-1 refold bucket"
+        )
+    event_p99_ms = float(np.percentile(latencies, 99))
+
+    # correlated flash crowd: ONE shared envelope window scales every
+    # variant's λ — storm entry/exit are whole-fleet event cycles
+    env = flash_envelope(3600.0, seed=20, spikes=1, spike_scale=6.0)
+
+    def storm_cycle(scale: float) -> tuple[float, int]:
+        moved = []
+        for i in eligible:
+            servers[i].load.arrival_rate *= scale
+            moved.append(names[i])
+        t0 = time.perf_counter()
+        queue.mark(moved, source=SOURCE_LAMBDA, wake=False)
+        dirty = queue.drain()
+        calculate_fleet(system, backend=backend, event_dirty=dirty)
+        solve_unlimited(system)
+        return (time.perf_counter() - t0) * 1000.0, len(moved)
+
+    storm_enter_ms, storm_dirty = storm_cycle(env.spike_scale)
+    storm_exit_ms, _ = storm_cycle(1.0 / env.spike_scale)
+
+    got = {}
+    for name, server in system.servers.items():
+        a = server.allocation
+        got[name] = None if a is None else (
+            a.accelerator, a.num_replicas, a.cost, a.value,
+            a.itl, a.ttft, a.rho,
+        )
+
+    # event ≡ poll decision-surface parity: the full path
+    # (INCREMENTAL_CYCLE=0) on a fresh System carrying the same final
+    # loads — raises on divergence
+    prior_env = os.environ.get("INCREMENTAL_CYCLE")
+    os.environ["INCREMENTAL_CYCLE"] = "0"
+    try:
+        reset_fleet_state()
+        ref_system = System(spec)
+        for ref_s, inc_s in zip(
+            ref_system.servers.values(), system.servers.values()
+        ):
+            if ref_s.load is not None and inc_s.load is not None:
+                ref_s.load.arrival_rate = inc_s.load.arrival_rate
+        calculate_fleet(ref_system, backend=backend)
+        solve_unlimited(ref_system)
+        want = {}
+        for name, server in ref_system.servers.items():
+            a = server.allocation
+            want[name] = None if a is None else (
+                a.accelerator, a.num_replicas, a.cost, a.value,
+                a.itl, a.ttft, a.rho,
+            )
+    finally:
+        if prior_env is None:
+            del os.environ["INCREMENTAL_CYCLE"]
+        else:  # restore the operator's explicit setting
+            os.environ["INCREMENTAL_CYCLE"] = prior_env
+        reset_fleet_state()
+
+    mismatches = 0
+    max_op_rel = 0.0
+    for name, w in want.items():
+        g = got[name]
+        if (w is None) != (g is None):
+            mismatches += 1
+            continue
+        if w is None:
+            continue
+        if g[:4] != w[:4]:  # accelerator, replicas, cost, value: BIT-equal
+            mismatches += 1
+            continue
+        for gv, wv in zip(g[4:], w[4:]):  # itl/ttft/rho: ULP band
+            denom = max(abs(wv), 1e-9)
+            max_op_rel = max(max_op_rel, abs(gv - wv) / denom)
+    if mismatches or max_op_rel > 1e-4:
+        raise AssertionError(
+            f"event/poll divergence: {mismatches} decision mismatches, "
+            f"max operating-point rel err {max_op_rel:.2e}"
+        )
+
+    latency_budget_ms = 1000.0
+    reduction_floor = 10.0
+    if assert_full_scale:
+        assert event_p99_ms < latency_budget_ms, (
+            f"1M-variant p99 event->decision latency {event_p99_ms:.0f} ms "
+            f">= {latency_budget_ms:.0f} ms"
+        )
+        assert work_reduction >= reduction_floor, (
+            f"event path touched {event_work} servers/cycle vs the poll "
+            f"loop's {poll_work} — {work_reduction:.1f}x < "
+            f"{reduction_floor:.0f}x at {events_fraction:.0%} events"
+        )
+    return {
+        "n_variants": n_variants,
+        "backend": backend,
+        "platform": jax.default_backend(),
+        "events_fraction": events_fraction,
+        "event_steady_ms": round(event_steady_ms, 1),
+        "event_steady_ms_all": [round(t, 1) for t in ev_all],
+        "event_steady_ms_spread": round(max(ev_warm) - min(ev_warm), 1),
+        "steady_compile_cycles": len(ev_all) - len(ev_warm),
+        "poll_steady_ms": round(poll_steady_ms, 1),
+        "poll_steady_ms_spread": round(max(poll_warm) - min(poll_warm), 1),
+        "event_p99_latency_ms": round(event_p99_ms, 1),
+        "event_p99_latency_ms_spread": round(
+            max(batch_p99s) - min(batch_p99s), 1
+        ),
+        "latency_compile_cycles": latency_compiles,
+        "event_scanned_servers": ev_scanned,
+        "event_solved_servers": ev_solved,
+        "poll_scanned_servers": poll_scanned,
+        "poll_solved_servers": poll_solved,
+        "work_reduction_x": round(work_reduction, 1),
+        "queue": {
+            "marks": queue.marks,
+            "wakes_fired": queue.wakes_fired,
+            "wakes_coalesced": queue.wakes_coalesced,
+        },
+        "storm": {
+            "spike_scale": env.spike_scale,
+            "windows": [list(w) for w in env.windows],
+            "enter_ms": round(storm_enter_ms, 1),
+            "exit_ms": round(storm_exit_ms, 1),
+            "dirty_servers": storm_dirty,
+        },
+        "latency_budget_ms": latency_budget_ms,
+        "reduction_floor_x": reduction_floor,
+        "parity": {
+            "servers_compared": len(want),
+            "decision_mismatches": mismatches,
+            "max_operating_point_rel_err": float(f"{max_op_rel:.3e}"),
+        },
+        "provenance": (
+            f"{backend} backend on {jax.default_backend()}; one persistent "
+            f"{n_variants}-variant fleet; {events_fraction:.0%} of arrival "
+            "rates move per cycle, fed through the watch DirtyQueue into "
+            "the event-authoritative scan vs the same traffic through the "
+            "poll-loop signature scan (min of warm cycles, jit filtered, "
+            "GC quiesced); p99 latency over warm single-variant event "
+        "cycles (stray refold-bucket compiles excluded and counted); "
+            "storm = flash_envelope whole-fleet λ scale; parity asserted "
+            "against an INCREMENTAL_CYCLE=0 full solve of the same inputs"
+        ),
+    }
+
+
 def capacity_solve_bench(
     n_variants: int = 10000,
     fractions: tuple[float, ...] = (1.0, 0.8, 0.5),
@@ -2762,7 +3102,8 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
                        spot: dict | None = None,
                        profile: dict | None = None,
                        incremental: dict | None = None,
-                       twin: dict | None = None) -> dict:
+                       twin: dict | None = None,
+                       event: dict | None = None) -> dict:
     """Everything the bench measures, in one document — written to
     `bench_full.json`, NOT printed (the printed line is `compact_line`)."""
     return {
@@ -2851,6 +3192,12 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
         # full solve + 1%-dirty steady cycle + incremental/full parity,
         # all asserted in the bench itself
         **({"incremental": incremental} if incremental else {}),
+        # event-driven reconcile (ISSUE-20): watch-fed dirty sets
+        # through the event-authoritative scan at 1M variants — p99
+        # event->decision latency, >=10x scanned+solved reduction vs the
+        # poll loop, and event==poll bit-parity all asserted in the
+        # bench itself
+        **({"event": event} if event else {}),
         # vectorized fleet twin (ISSUE-19): 1000 emulated engines in one
         # event loop vs the serial scalar-engine oracle — >=10x speedup,
         # bit-parity, and the closed-loop policy A/B all asserted in the
@@ -2862,6 +3209,8 @@ def build_full_payload(ns: dict, cycles: dict, tpu_probe: dict,
 # optional `extra` fields in drop order on a 1024-byte overflow: least
 # headline-critical first (the full payload always carries everything)
 _COMPACT_DROP_ORDER = (
+    "event_p99_ms",
+    "event_steady_ms",
     "twin_fleet_ms",
     "twin_speedup",
     "spot_violation_s_reactive",
@@ -2914,7 +3263,8 @@ def compact_line(ns: dict, cycles: dict, tpu_probe: dict,
                  spot: dict | None = None,
                  profile: dict | None = None,
                  incremental: dict | None = None,
-                 twin: dict | None = None) -> str:
+                 twin: dict | None = None,
+                 event: dict | None = None) -> str:
     """The ONE printed JSON line. Round-4 postmortem: the driver captures
     only a tail window of stdout, and round 4's ~4 KB single line was cut
     mid-object (`BENCH_r04.json parsed: null`) — a benchmark whose number
@@ -2964,6 +3314,9 @@ def compact_line(ns: dict, cycles: dict, tpu_probe: dict,
         **({"twin_fleet_ms": twin["twin_fleet_ms"],
             "twin_speedup": twin["twin_speedup"]}
            if twin and "twin_fleet_ms" in twin else {}),
+        **({"event_p99_ms": event["event_p99_latency_ms"],
+            "event_steady_ms": event["event_steady_ms"]}
+           if event and "event_p99_latency_ms" in event else {}),
         **({"profile_overhead_pct": profile["profile_overhead_pct"],
             "cycle_jit_ms": profile["cycle_jit_ms"],
             "cycle_solve_ms": profile["cycle_solve_ms"]}
@@ -3083,6 +3436,16 @@ def main() -> None:
                          "sizing budget, 1%%-dirty steady cycle < 100 ms, "
                          "incremental-vs-full parity all ASSERTED), print "
                          "its JSON, and merge it into bench_full.json")
+    ap.add_argument("--event", action="store_true",
+                    help="run ONLY the event-driven reconcile benchmark "
+                         "(make bench-event: 1M variants; p99 "
+                         "single-variant event->decision latency < 1 s on "
+                         "CPU, >=10x fewer scanned+solved servers per "
+                         "cycle vs the poll loop at 1%% events, event==poll "
+                         "decision-surface bit-parity all ASSERTED), print "
+                         "its JSON, and merge it into bench_full.json; "
+                         "--quick shrinks the fleet (asserts only apply at "
+                         "1M)")
     args = ap.parse_args()
     if args.cycle:
         print(json.dumps(reconcile_cycle_bench(args.cycle_variants)))
@@ -3162,6 +3525,14 @@ def main() -> None:
         incremental = incremental_cycle_bench()
         merge_full("incremental", incremental)
         print(json.dumps(incremental))
+        return
+    if args.event:
+        _pin_cpu_if_tpu_unreachable()
+        event = event_reconcile_bench(
+            n_variants=20_000 if args.quick else 1_000_000,
+        )
+        merge_full("event", event)
+        print(json.dumps(event))
         return
     if args.twin:
         _pin_cpu_if_tpu_unreachable()
@@ -3298,6 +3669,20 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — artifact must survive
             incremental = {"error": f"{type(e).__name__}: {e}"}
             sp.set(error=str(e))
+    # event-driven reconcile (ISSUE-20): guarded; --quick shrinks the
+    # fleet (the latency/reduction budgets only assert at 1M — parity
+    # raises at any scale)
+    with tracer.span("event-reconcile-bench") as sp:
+        try:
+            event = event_reconcile_bench(
+                n_variants=5000 if args.quick else 1_000_000,
+                steady_cycles=4 if args.quick else 6,
+                warmup_cycles=3 if args.quick else 4,
+                single_events=9 if args.quick else 24,
+            )
+        except Exception as e:  # noqa: BLE001 — artifact must survive
+            event = {"error": f"{type(e).__name__}: {e}"}
+            sp.set(error=str(e))
     # vectorized fleet twin (ISSUE-19): guarded; --quick shrinks the A/B
     # pool only — the 1000-engine floor and the 10x/parity asserts are
     # the whole point and never shrink
@@ -3330,12 +3715,13 @@ def main() -> None:
                                       spot=spot,
                                       profile=profile,
                                       incremental=incremental,
+                                      event=event,
                                       twin=twin),
                    indent=1) + "\n"
     )
     print(compact_line(ns, cycles, tpu_probe, measured, calibrated,
                        reconcile_cycle, sizing, capacity, planner, montecarlo,
-                       recorder, spot, profile, incremental, twin))
+                       recorder, spot, profile, incremental, twin, event))
 
 
 if __name__ == "__main__":

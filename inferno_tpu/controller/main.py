@@ -257,10 +257,16 @@ def main() -> int:
         elector.start()
 
     # event-driven triggers: VA creation and ConfigMap edits wake the loop
-    # early (reference: watch config, controller.go:456-487)
+    # early (reference: watch config, controller.go:456-487); with the
+    # reconciler's DirtyQueue attached, events also mark WHICH variant
+    # changed, feeding the targeted incremental scan (ISSUE-20)
     from inferno_tpu.controller.watch import Watcher
 
-    watcher = Watcher(kube, rec.poke, config_namespace=config.config_namespace)
+    watcher = Watcher(
+        kube, rec.poke,
+        config_namespace=config.config_namespace,
+        dirty=rec.dirty_queue,
+    )
     watcher.start()
 
     try:

@@ -799,6 +799,43 @@ class TwinInstruments:
         self.engines.set(labels, float(plant.engines))
 
 
+METRIC_EVENT_QUEUE_DEPTH = "inferno_event_queue_depth"
+METRIC_SHARD_OWNED = "inferno_shard_owned_servers"
+LABEL_SHARD = "shard"
+
+
+class EventInstruments:
+    """Prometheus surface of the event-driven reconcile path (ISSUE-20):
+    the DirtyQueue's coalescing behavior and, under sharded controllers
+    (controller/shard.py), each shard's owned-variant count. Registered
+    unconditionally, like every other instrument block, so the metric
+    catalog is independent of whether events or shards are in use — an
+    interval-only controller just exports the series at zero."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self.queue_depth = self.registry.gauge(
+            METRIC_EVENT_QUEUE_DEPTH,
+            "Dirty variants pending in the event DirtyQueue when the "
+            "reconcile cycle drained it (coalesced distinct names, all "
+            "sources: watch, lambda-delta, config)",
+        )
+        self.shard_owned = self.registry.gauge(
+            METRIC_SHARD_OWNED,
+            "Variants owned by each controller shard under the "
+            "consistent-hash fleet partition (label: shard member name); "
+            "unsharded controllers export nothing here",
+        )
+
+    def observe_drain(self, depth: int) -> None:
+        """Publish the queue depth seen by the cycle's drain."""
+        self.queue_depth.set({}, float(depth))
+
+    def observe_shard(self, shard: str, owned: int) -> None:
+        """Publish one shard's owned-variant count after a (re)partition."""
+        self.shard_owned.set({LABEL_SHARD: shard}, float(owned))
+
+
 class TLSConfig:
     """Serve-side TLS with cert reload (the reference uses certwatchers on
     its metrics endpoint, cmd/main.go:122-199). Certs are re-read when the

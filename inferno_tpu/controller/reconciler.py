@@ -383,11 +383,14 @@ class Reconciler:
         from inferno_tpu.controller.metrics import (
             AttainmentInstruments,
             CycleInstruments,
+            EventInstruments,
             ForecastInstruments,
             MetricsEmitter,
             ProfilerInstruments,
             SpotInstruments,
         )
+        from inferno_tpu.controller.shard import shard_from_env
+        from inferno_tpu.controller.watch import DirtyQueue
 
         from inferno_tpu.controller.logger import get_logger
 
@@ -483,6 +486,22 @@ class Reconciler:
         # variant counts a detected preemption.
         self.spot_instruments = SpotInstruments(self.emitter.registry)
         self._prev_spot: dict[str, tuple[int, int, str]] = {}
+        # event-driven reconcile (ISSUE-20): the coalescing dirty queue
+        # the Watcher (and any λ-delta observer) feeds; drained at solve
+        # time into the targeted incremental scan. Gauges register
+        # unconditionally (lint parity); an interval-only controller
+        # just drains empty sets.
+        self.event_instruments = EventInstruments(self.emitter.registry)
+        self.dirty_queue = DirtyQueue(wake=self.poke)
+        # last cycle's per-variant load signature (arrival, in, out) —
+        # the λ-delta dirty source: collect-stage changes are diffed
+        # here and marked into the queue before the targeted scan
+        self._prev_load_sig: dict[str, tuple | None] = {}
+        # consistent-hash fleet partition (ISSUE-20, SHARD_MEMBERS /
+        # SHARD_NAME): when sharded, this controller reconciles only the
+        # variants the rendezvous hash assigns to shard_name; None means
+        # unsharded (whole fleet)
+        self.shard_map, self.shard_name = shard_from_env()
         # flight recorder (obs/recorder.py, env FLIGHT_RECORDER_DIR,
         # default off): per-cycle fleet snapshot + decisions, enqueued in
         # _finish_cycle and written off the hot path
@@ -515,6 +534,9 @@ class Reconciler:
         # forecast/stabilizer timestamp source — injectable so tests can
         # step cycles at a controlled cadence instead of real time
         self.clock: Callable[[], float] = time.monotonic
+        # event-storm absorb sleep (run_forever's debounce window) —
+        # injectable so the burst-coalescing test steps it virtually
+        self.sleep: Callable[[float], None] = time.sleep
         # set by a Watcher (or anyone) to trigger the next cycle early
         self._wake = threading.Event()
         # Leadership gate, re-checked at every write: a leader deposed
@@ -1197,6 +1219,18 @@ class Reconciler:
                 report.optimization_ok = False
                 sp.set(error=str(e))
                 return
+            if self.shard_map is not None:
+                # sharded controller (ISSUE-20): reconcile only the
+                # variants the rendezvous hash assigns to this member.
+                # Export the full partition's ownership counts — a pure
+                # function of (membership, listed fleet), so every
+                # replica publishes identical inferno_shard_owned_servers
+                # series and dashboards need not join across scrapes.
+                buckets = self.shard_map.partition(va.full_name for va in vas)
+                for member, names in buckets.items():
+                    self.event_instruments.observe_shard(member, len(names))
+                mine = set(buckets[self.shard_name])
+                vas = [va for va in vas if va.full_name in mine]
             report.variants_seen = len(vas)
             sp.set(variants_seen=len(vas), accelerators=len(accelerators))
             # deleted variants: drop their telemetry state, gauge series,
@@ -1354,11 +1388,13 @@ class Reconciler:
                         # prefer INCREMENTAL_CYCLE at fleet scale — its
                         # skip covers fold, writeback, and solve, not
                         # just the sizing replay (docs/performance.md).
+                        event_dirty = self._drain_event_dirty(system)
                         calculate_fleet(
                             system, backend=self.config.compute_backend,
-                            only=to_size,
+                            only=to_size, event_dirty=event_dirty,
                         )
                         self._publish_dirty(system)
+                        self._remark_event_dirty(system, event_dirty)
                     else:
                         system.calculate_all(only=to_size)
                 else:
@@ -1402,6 +1438,65 @@ class Reconciler:
         with tracer.span("actuate") as sp:
             self._apply(prepared, solution, report, system)
             sp.set(variants_applied=report.variants_applied)
+
+    def _drain_event_dirty(self, system: System) -> list[str] | None:
+        """The targeted cycle's dirty set: drain the coalesced event
+        queue after folding in the λ-delta source. Returns None — run
+        the full poll scan — when targeting is disabled
+        (EVENT_TARGETED_CYCLE=0), after a config-change `mark_all`, or
+        on the queue's periodic anti-entropy cadence.
+
+        The λ-delta source is the collect stage itself: each cycle's
+        per-variant load signature (arrival rate, token mix — the
+        grouped collector's output) is diffed against the previous
+        cycle's and movers are marked. Combined with the Watcher's VA
+        marks and `_remark_event_dirty` (actuation changes current
+        allocations), every mutation path THIS controller can see is an
+        event source; external drift (kubectl scale, a missed watch
+        event) is bounded by the anti-entropy full scan."""
+        from inferno_tpu.config.defaults import env_flag
+
+        if not env_flag("EVENT_TARGETED_CYCLE", True):
+            return None
+        from inferno_tpu.controller.watch import SOURCE_LAMBDA
+
+        prev = self._prev_load_sig
+        cur: dict[str, tuple | None] = {}
+        moved: list[str] = []
+        for name, server in system.servers.items():
+            load = server.load
+            sig = None if load is None else (
+                load.arrival_rate, load.avg_in_tokens, load.avg_out_tokens
+            )
+            cur[name] = sig
+            if name not in prev or prev[name] != sig:
+                moved.append(name)
+        self._prev_load_sig = cur
+        q = self.dirty_queue
+        if moved:
+            q.mark(moved, source=SOURCE_LAMBDA, wake=False)
+        self.event_instruments.observe_drain(q.depth())
+        return q.drain()
+
+    def _remark_event_dirty(self, system: System, event_dirty) -> None:
+        """Re-mark this cycle's dirty variants for the NEXT cycle: the
+        actuation that follows may change their current allocations, and
+        an event-authoritative scan would otherwise not re-read them
+        (stale transition penalties until anti-entropy). Converges: a
+        variant that comes back CLEAN stops being re-marked."""
+        if event_dirty is None:
+            return
+        fd = getattr(system, "fleet_dirty", None)
+        if fd is None or not len(fd.dirty_pos):
+            return
+        from inferno_tpu.controller.watch import SOURCE_ACTUATE
+
+        names = list(system.servers)
+        self.dirty_queue.mark(
+            (names[p] for p in fd.dirty_pos.tolist()),
+            source=SOURCE_ACTUATE,
+            wake=False,
+        )
 
     def _publish_dirty(self, system: System) -> None:
         """Publish the incremental cycle's dirty outcome
@@ -1630,9 +1725,11 @@ class Reconciler:
 
     def _heartbeat(self, interval_seconds: int) -> None:
         """Refresh the readiness staleness heartbeat (cycle completion or
-        non-leader standby idle)."""
+        non-leader standby idle). Reads `self.clock` (default wall
+        monotonic, matching the probe's comparison clock) — injectable,
+        so the INF005 allowlist entry for this method is gone."""
         if self.ready_flag is not None:
-            self.ready_flag["last_cycle_monotonic"] = time.monotonic()
+            self.ready_flag["last_cycle_monotonic"] = self.clock()
             self.ready_flag["max_cycle_age_s"] = 3.0 * max(interval_seconds, 1)
 
     def _apply(
@@ -1967,5 +2064,14 @@ class Reconciler:
             )
             # interval sleep, interruptible by watch events (reference:
             # RequeueAfter steady state + create/ConfigMap triggers)
-            self._wake.wait(max(report.interval_seconds, 1))
+            woke = self._wake.wait(max(report.interval_seconds, 1))
+            if woke:
+                # debounce (ISSUE-20): absorb the rest of the event
+                # storm before cycling, so a burst of wakes inside one
+                # window produces ONE cycle (their dirty marks coalesce
+                # in the queue and drain together) instead of
+                # back-to-back full reconciles per event
+                debounce = self.dirty_queue.debounce_s
+                if debounce > 0:
+                    self.sleep(debounce)
             self._wake.clear()

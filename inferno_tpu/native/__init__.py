@@ -129,6 +129,33 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.c_int32,  # n_threads
                 _U8, _D, _D, _I, _D, _D, _D, _D,  # outputs
             ]
+            rfn = lib.inferno_fleet_refold
+            rfn.restype = ctypes.c_int
+            rfn.argtypes = [
+                ctypes.c_int32,  # n_lanes
+                _D, _D, _D, _D,  # alpha beta gamma delta
+                _D, _D,  # in_tokens out_tokens
+                _I, _I,  # max_batch occupancy_cap
+                _D, _D, _D,  # targets ttft itl tps
+                _D, _I, _D,  # total_rate min_replicas cost_per_replica
+                _D, _D, _U8,  # cached lambda_star rate_star feasible
+                ctypes.c_int32,  # n_threads
+                _U8, _D, _D, _I, _D, _D, _D, _D,  # outputs
+            ]
+            trfn = lib.inferno_tandem_refold
+            trfn.restype = ctypes.c_int
+            trfn.argtypes = [
+                ctypes.c_int32,  # n_lanes
+                _D, _D, _D, _D,  # alpha beta gamma delta
+                _D, _D,  # in_tokens out_tokens
+                _I, _I, _I, _I,  # prefill/decode batch, prefill/decode cap
+                _D, _D,  # prefill_slices decode_slices
+                _D, _D, _D,  # targets ttft itl tps
+                _D, _I, _D,  # total_rate min_replicas cost_per_replica
+                _D, _D, _U8,  # cached lambda_star rate_star feasible
+                ctypes.c_int32,  # n_threads
+                _U8, _D, _D, _I, _D, _D, _D, _D,  # outputs
+            ]
             _lib = lib
         except (OSError, subprocess.CalledProcessError, AttributeError) as e:
             # AttributeError: a stale prebuilt .so missing a newer symbol
@@ -201,6 +228,88 @@ def _run_sizer(symbol: str, inputs: tuple, n: int, n_iters: int,
     return out._replace(feasible=out.feasible.astype(bool))
 
 
+def _run_refold(symbol: str, inputs: tuple, n: int, lambda_star, rate_star,
+                feasible, n_threads: int) -> NativeFleetResult:
+    """Shared marshalling for the C refold kernels: like _run_sizer but
+    the cached bisection outputs go IN and there is no bisection depth or
+    tail margin to pass (the refold never bisects)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_error}")
+    if n_threads <= 0:
+        n_threads = os.cpu_count() or 1
+    out = NativeFleetResult(
+        feasible=np.zeros(n, np.uint8),
+        lambda_star=np.zeros(n, np.float64),
+        rate_star=np.zeros(n, np.float64),
+        num_replicas=np.zeros(n, np.int32),
+        cost=np.zeros(n, np.float64),
+        itl=np.zeros(n, np.float64),
+        ttft=np.zeros(n, np.float64),
+        rho=np.zeros(n, np.float64),
+    )
+    lam_in = _d(lambda_star)
+    rate_in = _d(rate_star)
+    feas_in = np.ascontiguousarray(np.asarray(feasible), dtype=np.uint8)
+    rc = getattr(lib, symbol)(
+        n, *inputs, lam_in, rate_in, feas_in, n_threads,
+        out.feasible, out.lambda_star, out.rate_star, out.num_replicas,
+        out.cost, out.itl, out.ttft, out.rho,
+    )
+    if rc != 0:
+        raise RuntimeError(f"{symbol} failed with code {rc}")
+    return out._replace(feasible=out.feasible.astype(bool))
+
+
+def fleet_refold_native(
+    params, lambda_star, rate_star, feasible, n_threads: int = 0,
+) -> NativeFleetResult:
+    """λ-only refold of a FleetParams batch with the C++ solver: the
+    cached rate-independent bisection outputs (lambda_star / rate_star /
+    feasible, from any previous full solve) pass through; only the
+    offered-load fold and the per-replica operating point recompute.
+    Semantics match ops.queueing.fleet_refold — the decision surface
+    (num_replicas, cost) is folded in f32 and is bit-identical to the
+    jax refold; itl/ttft/rho come from the f64 stationary solve (within
+    the documented 1e-4 relative tolerance)."""
+    alpha = _d(params.alpha)
+    return _run_refold(
+        "inferno_fleet_refold",
+        (
+            alpha, _d(params.beta), _d(params.gamma), _d(params.delta),
+            _d(params.in_tokens), _d(params.out_tokens),
+            _i(params.max_batch), _i(params.occupancy_cap),
+            _d(params.target_ttft), _d(params.target_itl), _d(params.target_tps),
+            _d(params.total_rate), _i(params.min_replicas),
+            _d(params.cost_per_replica),
+        ),
+        alpha.shape[0], lambda_star, rate_star, feasible, n_threads,
+    )
+
+
+def tandem_refold_native(
+    params, lambda_star, rate_star, feasible, n_threads: int = 0,
+) -> NativeFleetResult:
+    """λ-only refold of a TandemParams batch with the C++ solver: the
+    disaggregated analogue of fleet_refold_native (semantics of
+    ops.queueing.tandem_refold, same f32 decision-surface contract)."""
+    alpha = _d(params.alpha)
+    return _run_refold(
+        "inferno_tandem_refold",
+        (
+            alpha, _d(params.beta), _d(params.gamma), _d(params.delta),
+            _d(params.in_tokens), _d(params.out_tokens),
+            _i(params.prefill_batch), _i(params.decode_batch),
+            _i(params.prefill_cap), _i(params.decode_cap),
+            _d(params.prefill_slices), _d(params.decode_slices),
+            _d(params.target_ttft), _d(params.target_itl), _d(params.target_tps),
+            _d(params.total_rate), _i(params.min_replicas),
+            _d(params.cost_per_replica),
+        ),
+        alpha.shape[0], lambda_star, rate_star, feasible, n_threads,
+    )
+
+
 def fleet_size_native(
     params, n_iters: int = DEFAULT_BISECT_ITERS, n_threads: int = 0,
     ttft_tail_margin: float | None = None,
@@ -254,7 +363,9 @@ __all__ = [
     "DEFAULT_BISECT_ITERS",
     "NativeFleetResult",
     "available",
+    "fleet_refold_native",
     "fleet_size_native",
     "load_error",
+    "tandem_refold_native",
     "tandem_size_native",
 ]

@@ -266,6 +266,62 @@ void size_lane(const Lane& ln, int32_t n_iters, double ttft_tail_margin,
   *rho = std::clamp(s.in_servers / ln.max_batch, 0.0, 1.0);
 }
 
+// -- rate-only refold ---------------------------------------------------------
+//
+// The λ-only fast path (ops.queueing.fleet_refold): given the cached
+// rate-independent bisection outputs (lambda_star, rate_star, feasible —
+// functions of profiles and SLO targets only), recompute the offered-load
+// fold and the per-replica operating point. ONE stationary solve instead
+// of the bisection's ~66. The DECISION SURFACE (num_replicas, cost) is
+// computed in f32 — the identical IEEE divide/ceil/int-cast/multiply the
+// jitted `fold_replicas` runs — so a native refold and a jax refold of
+// the same lane agree bit-for-bit on what the controller actuates; the
+// operating point (itl/ttft/rho) uses this file's f64 stationary solve
+// and agrees within the documented 1e-4 relative tolerance.
+
+int32_t fold_replicas_f32(float total, float rate_star, int32_t min_replicas) {
+  // ops.queueing.fold_replicas: f32 divide, ceil, int32 cast, fused
+  // max(r, max(min_replicas, 1)) clamp
+  const float q = total / rate_star;
+  const float c = std::ceil(q);
+  int32_t replicas =
+      c >= 2147483648.0f ? INT32_MAX : static_cast<int32_t>(c);
+  return std::max(replicas, std::max(min_replicas, 1));
+}
+
+float offered_load_f32(double target_tps, double out_tokens,
+                       double total_rate) {
+  // ops.queueing.offered_load, in the f32 the jitted kernels use
+  const float tps = static_cast<float>(target_tps);
+  return tps > 0.0f ? tps / static_cast<float>(out_tokens)
+                    : static_cast<float>(total_rate);
+}
+
+void refold_lane(const Lane& ln, double rate_star_in, int32_t* num_replicas,
+                 double* cost, double* itl_out, double* ttft_out,
+                 double* rho) {
+  const Grid g = make_grid(ln);
+  const double lam_min = service_rate(ln, 1.0) * kRateEps;
+
+  const float total =
+      offered_load_f32(ln.target_tps, ln.out_tokens, ln.total_rate);
+  const int32_t replicas = fold_replicas_f32(
+      total, static_cast<float>(rate_star_in), ln.min_replicas);
+  *num_replicas = replicas;
+  *cost = static_cast<float>(replicas) *
+          static_cast<float>(ln.cost_per_replica);
+
+  double per_replica = static_cast<double>(total) / replicas / 1000.0;
+  per_replica = std::max(per_replica, lam_min);
+  const Stats s = solve_stats(per_replica, g);
+  const double conc = concurrency(ln, s.serv);
+  const double prefill =
+      ln.in_tokens > 0.0 ? ln.gamma + ln.delta * ln.in_tokens * conc : 0.0;
+  *itl_out = ln.alpha + ln.beta * conc;
+  *ttft_out = s.wait + prefill;
+  *rho = std::clamp(s.in_servers / ln.max_batch, 0.0, 1.0);
+}
+
 // -- disaggregated (prefill/decode tandem) lanes ------------------------------
 //
 // One replica is an atomic unit of prefill + decode engines
@@ -394,6 +450,41 @@ void size_tandem_lane(const TandemLane& ln, int32_t n_iters,
   *rho = e.rho;
 }
 
+// Tandem analogue of refold_lane (ops.queueing.tandem_refold): f32 fold
+// against the cached per-unit capacity, one two-stage evaluation for the
+// operating point.
+void refold_tandem_lane(const TandemLane& ln, double rate_star_in,
+                        int32_t* num_replicas, double* cost, double* itl_out,
+                        double* ttft_out, double* rho) {
+  const double nd = tandem_num_decodes(ln);
+  const double p_slope = ln.delta * ln.in_tokens;
+  const Grid gp =
+      make_stage_grid(ln.gamma, p_slope, ln.prefill_batch, ln.prefill_cap);
+  const Grid gd = make_stage_grid(nd * ln.alpha, nd * ln.beta,
+                                  ln.decode_batch, ln.decode_cap);
+  const double pb = ln.prefill_batch, db = ln.decode_batch;
+  const double mu_p_full = pb / (ln.gamma + p_slope * pb);
+  const double mu_d_full = db / (nd * (ln.alpha + ln.beta * db));
+  const double unit_max =
+      std::min(mu_p_full * ln.prefill_slices, mu_d_full * ln.decode_slices);
+  const double lam_min = unit_max * kRateEps;
+
+  const float total =
+      offered_load_f32(ln.target_tps, ln.out_tokens, ln.total_rate);
+  const int32_t replicas = fold_replicas_f32(
+      total, static_cast<float>(rate_star_in), ln.min_replicas);
+  *num_replicas = replicas;
+  *cost = static_cast<float>(replicas) *
+          static_cast<float>(ln.cost_per_replica);
+
+  double per_unit = static_cast<double>(total) / replicas / 1000.0;
+  per_unit = std::max(per_unit, lam_min);
+  const TandemEval e = tandem_eval(per_unit, ln, gp, gd);
+  *itl_out = e.itl;
+  *ttft_out = e.ttft;
+  *rho = e.rho;
+}
+
 // Shared worker-pool dispatch: run(i) over lanes, serial when one worker.
 template <typename F>
 void for_each_lane(int32_t n_lanes, int32_t n_threads, F&& run) {
@@ -464,6 +555,59 @@ int inferno_fleet_size(
   return 0;
 }
 
+// λ-only refold of aggregated lanes (ops.queueing.fleet_refold): the
+// cached bisection outputs come IN (lambda_star_in / rate_star_in /
+// feasible_in, from a previous full solve) and pass through to the
+// outputs unchanged; only the offered-load fold and the operating point
+// are recomputed. Returns 0 on success; all arrays n_lanes elements.
+int inferno_fleet_refold(
+    int32_t n_lanes, const double* alpha, const double* beta,
+    const double* gamma, const double* delta, const double* in_tokens,
+    const double* out_tokens, const int32_t* max_batch,
+    const int32_t* occupancy_cap, const double* target_ttft,
+    const double* target_itl, const double* target_tps,
+    const double* total_rate, const int32_t* min_replicas,
+    const double* cost_per_replica, const double* lambda_star_in,
+    const double* rate_star_in, const uint8_t* feasible_in,
+    int32_t n_threads, uint8_t* feasible, double* lambda_star,
+    double* rate_star, int32_t* num_replicas, double* cost, double* itl,
+    double* ttft, double* rho) {
+  if (n_lanes < 0) return 1;
+  auto run = [&](int32_t i) {
+    Lane ln;
+    ln.alpha = alpha[i];
+    ln.beta = beta[i];
+    ln.gamma = gamma[i];
+    ln.delta = delta[i];
+    ln.in_tokens = in_tokens[i];
+    ln.out_tokens = out_tokens[i];
+    ln.max_batch = max_batch[i];
+    ln.occupancy_cap = occupancy_cap[i];
+    ln.target_ttft = target_ttft[i];
+    ln.target_itl = target_itl[i];
+    ln.target_tps = target_tps[i];
+    ln.total_rate = total_rate[i];
+    ln.min_replicas = min_replicas[i];
+    ln.cost_per_replica = cost_per_replica[i];
+    if (ln.max_batch <= 0 || ln.occupancy_cap < ln.max_batch ||
+        ln.out_tokens < 1.0 || service_time(ln, 1.0) <= 0.0 ||
+        service_time(ln, ln.max_batch) <= 0.0 || !(rate_star_in[i] > 0.0)) {
+      feasible[i] = 0;
+      lambda_star[i] = rate_star[i] = cost[i] = itl[i] = ttft[i] = rho[i] = 0.0;
+      num_replicas[i] = 0;
+      return;
+    }
+    feasible[i] = feasible_in[i];
+    lambda_star[i] = lambda_star_in[i];
+    rate_star[i] = rate_star_in[i];
+    refold_lane(ln, rate_star_in[i], &num_replicas[i], &cost[i], &itl[i],
+                &ttft[i], &rho[i]);
+  };
+
+  for_each_lane(n_lanes, n_threads, run);
+  return 0;
+}
+
 // Disaggregated lanes. Returns 0 on success; all arrays n_lanes elements.
 int inferno_tandem_size(
     int32_t n_lanes, const double* alpha, const double* beta,
@@ -516,6 +660,69 @@ int inferno_tandem_size(
     size_tandem_lane(ln, n_iters, ttft_tail_margin, &feasible[i],
                      &lambda_star[i], &rate_star[i], &num_replicas[i],
                      &cost[i], &itl[i], &ttft[i], &rho[i]);
+  };
+
+  for_each_lane(n_lanes, n_threads, run);
+  return 0;
+}
+
+// λ-only refold of disaggregated lanes (ops.queueing.tandem_refold):
+// tandem analogue of inferno_fleet_refold, same pass-through contract.
+int inferno_tandem_refold(
+    int32_t n_lanes, const double* alpha, const double* beta,
+    const double* gamma, const double* delta, const double* in_tokens,
+    const double* out_tokens, const int32_t* prefill_batch,
+    const int32_t* decode_batch, const int32_t* prefill_cap,
+    const int32_t* decode_cap, const double* prefill_slices,
+    const double* decode_slices, const double* target_ttft,
+    const double* target_itl, const double* target_tps,
+    const double* total_rate, const int32_t* min_replicas,
+    const double* cost_per_replica, const double* lambda_star_in,
+    const double* rate_star_in, const uint8_t* feasible_in,
+    int32_t n_threads, uint8_t* feasible, double* lambda_star,
+    double* rate_star, int32_t* num_replicas, double* cost, double* itl,
+    double* ttft, double* rho) {
+  if (n_lanes < 0) return 1;
+  auto run = [&](int32_t i) {
+    TandemLane ln;
+    ln.alpha = alpha[i];
+    ln.beta = beta[i];
+    ln.gamma = gamma[i];
+    ln.delta = delta[i];
+    ln.in_tokens = in_tokens[i];
+    ln.out_tokens = out_tokens[i];
+    ln.prefill_batch = prefill_batch[i];
+    ln.decode_batch = decode_batch[i];
+    ln.prefill_cap = prefill_cap[i];
+    ln.decode_cap = decode_cap[i];
+    ln.prefill_slices = prefill_slices[i];
+    ln.decode_slices = decode_slices[i];
+    ln.target_ttft = target_ttft[i];
+    ln.target_itl = target_itl[i];
+    ln.target_tps = target_tps[i];
+    ln.total_rate = total_rate[i];
+    ln.min_replicas = min_replicas[i];
+    ln.cost_per_replica = cost_per_replica[i];
+    const double nd = tandem_num_decodes(ln);
+    if (ln.prefill_batch <= 0 || ln.decode_batch <= 0 ||
+        ln.prefill_cap < ln.prefill_batch || ln.decode_cap < ln.decode_batch ||
+        ln.prefill_slices < 1.0 || ln.decode_slices < 1.0 ||
+        ln.out_tokens < 1.0 ||
+        ln.gamma + ln.delta * ln.in_tokens <= 0.0 ||
+        ln.gamma + ln.delta * ln.in_tokens * ln.prefill_batch <= 0.0 ||
+        nd * (ln.alpha + ln.beta) <= 0.0 ||
+        nd * (ln.alpha + ln.beta * ln.decode_batch) <= 0.0 ||
+        !(rate_star_in[i] > 0.0)) {
+      feasible[i] = 0;
+      lambda_star[i] = rate_star[i] = cost[i] = itl[i] = ttft[i] = rho[i] = 0.0;
+      num_replicas[i] = 0;
+      return;
+    }
+    feasible[i] = feasible_in[i];
+    lambda_star[i] = lambda_star_in[i];
+    rate_star[i] = rate_star_in[i];
+    refold_tandem_lane(ln, rate_star_in[i], &num_replicas[i], &cost[i],
+                       &itl[i], &ttft[i], &rho[i]);
   };
 
   for_each_lane(n_lanes, n_threads, run);

@@ -34,9 +34,10 @@ def _normalize(text: str) -> str:
 # measured in. `_total` marks counters (unitless cumulative counts),
 # `_ratio` dimensionless gauges, the rest physical units (`_chips` and
 # `_replicas` are the capacity units of the spot/fleet gauges, ISSUE-11;
-# `_bytes` the profiler's memory high-water gauge, ISSUE-12).
+# `_bytes` the profiler's memory high-water gauge, ISSUE-12; `_servers`
+# the shard-partition ownership unit, ISSUE-20).
 UNIT_SUFFIXES = ("_seconds", "_ms", "_total", "_ratio", "_rpm", "_chips",
-                 "_replicas", "_bytes")
+                 "_replicas", "_bytes", "_servers")
 
 # Grandfathered pre-convention names: these shipped before the suffix
 # rule and are part of the external actuation/dashboard contract, so
@@ -50,6 +51,10 @@ UNIT_SUFFIX_ALLOWLIST = frozenset({
     "inferno_current_replicas",  # HPA/KEDA actuation contract
     "inferno_sizing_cache_lookups",  # ISSUE-5 cycle instrument
     "inferno_collect_concurrency",  # ISSUE-5 cycle instrument
+    # matches controller-runtime's conventional `workqueue_depth` shape
+    # so fleet dashboards can treat the event queue like any kube
+    # controller workqueue (ISSUE-20)
+    "inferno_event_queue_depth",
 })
 
 
@@ -128,13 +133,15 @@ def build_controller_registry():
     (ForecastInstruments), the SLO-attainment / model-error scoreboard
     gauges (AttainmentInstruments), the spot-market placement /
     preemption series (SpotInstruments), the cycle-profiler series
-    (ProfilerInstruments), and the fleet-twin progress series
-    (TwinInstruments) — each registered unconditionally, like the
+    (ProfilerInstruments), the fleet-twin progress series
+    (TwinInstruments), and the event-driven reconcile series
+    (EventInstruments) — each registered unconditionally, like the
     Reconciler does, so the catalog is identical whatever features are
     enabled."""
     from inferno_tpu.controller.metrics import (
         AttainmentInstruments,
         CycleInstruments,
+        EventInstruments,
         ForecastInstruments,
         MetricsEmitter,
         ProfilerInstruments,
@@ -151,6 +158,7 @@ def build_controller_registry():
     SpotInstruments(registry)
     ProfilerInstruments(registry)
     TwinInstruments(registry)
+    EventInstruments(registry)
     return registry
 
 

@@ -208,6 +208,24 @@ def metrics_from_bench_full(doc: dict) -> dict[str, Metric]:
     if "incremental_cold_ms" in out:
         out["incr_cold_ms"] = out["incremental_cold_ms"]
 
+    # event-driven reconcile (ISSUE-20, `make bench-event`): the p99
+    # single-variant event->decision latency and the 1%-events steady
+    # cycle are the deliverables — both noise-banded by their recorded
+    # warm-repeat spreads (batch-p99 spread for the latency, warm-cycle
+    # spread for the steady point). poll_steady_ms is a baseline, not a
+    # deliverable, and the storm entry/exit are single unrepeated
+    # whole-fleet measurements — deliberately NOT gated.
+    event = doc.get("event") or {}
+    for key in ("event_p99_latency_ms", "event_steady_ms"):
+        if _num(event.get(key)) is not None:
+            out[key] = Metric(
+                _num(event.get(key)),
+                _num(event.get(f"{key}_spread")) or 0.0,
+            )
+    # compact-line alias (the BENCH_r trajectory join uses this name)
+    if "event_p99_latency_ms" in out:
+        out["event_p99_ms"] = out["event_p99_latency_ms"]
+
     # vectorized fleet twin (ISSUE-19, `make bench-twin`): the warm
     # 1000-engine pass is the phase to watch, noise-banded by its
     # recorded warm-repeat spread. twin_fleet_cold_ms is deliberately

@@ -987,6 +987,7 @@ def calculate_fleet(
     only: set[str] | None = None,
     lam_tolerance: float = 0.0,
     max_age_cycles: int = 0,
+    event_dirty=None,
 ) -> int:
     """Replace System.calculate_all() with the batched fleet path.
 
@@ -1015,6 +1016,12 @@ def calculate_fleet(
     program for structure changes, the cheap refold for λ-only changes.
     `lam_tolerance`/`max_age_cycles` are the incremental scan's λ
     anchoring knobs (the sizing cache's tolerance semantics; 0 = exact).
+
+    `event_dirty` (iterable of server names, incremental path only)
+    runs the scan event-authoritative: only the named servers are
+    re-read and the O(fleet) content diff is skipped — the targeted
+    event cycle (controller/reconciler.py). Ignored on the
+    non-incremental path, where the full pass is a superset anyway.
     """
     if use_mesh and mesh is None:
         mesh = fleet_mesh()
@@ -1036,7 +1043,8 @@ def calculate_fleet(
         from inferno_tpu.parallel.incremental import incremental_cycle
 
         return incremental_cycle(
-            system, mesh, backend, lam_tolerance, max_age_cycles
+            system, mesh, backend, lam_tolerance, max_age_cycles,
+            event_dirty=event_dirty,
         )
     # a non-incremental pass over the state's own System voids the
     # incremental state: its replay claims about these servers go stale

@@ -136,6 +136,9 @@ class FleetDirty:
     dirty_lanes: int  # lanes solved through a kernel this cycle
     refold_lanes: int  # of those, lanes that took the cheap refold
     skipped_servers: int  # servers that replayed everything
+    # servers whose content the scan actually read (poll: the fleet;
+    # event-authoritative: the dirty set) — the event bench's work axis
+    scanned_servers: int = 0
 
 
 _state: _State | None = None
@@ -286,15 +289,25 @@ def incremental_cycle(
     backend: str,
     lam_tolerance: float = 0.0,
     max_age_cycles: int = 0,
+    event_dirty=None,
 ) -> int:
     """One incremental fleet cycle — the INCREMENTAL_CYCLE=1 body of
-    `calculate_fleet` (which owns the routing/eligibility decision)."""
+    `calculate_fleet` (which owns the routing/eligibility decision).
+
+    With `event_dirty` (an iterable of server names) the scan runs
+    event-authoritative: only the named servers are re-read and the
+    O(fleet) content diff is skipped (`FleetSnapshot.scan_event_update`,
+    which falls back to the full poll scan on any doubt). `None` — the
+    default, and the anti-entropy cadence — is the full poll scan."""
     global _state
     from inferno_tpu.parallel import fleet as F
 
     snap = F._get_snapshot()
     t0 = time.perf_counter()
-    snap.scan_update(system, lam_tolerance, max_age_cycles)
+    if event_dirty is None:
+        snap.scan_update(system, lam_tolerance, max_age_cycles)
+    else:
+        snap.scan_event_update(system, event_dirty, lam_tolerance)
     _prof.add_ms("snapshot_update_ms", (time.perf_counter() - t0) * 1000.0)
 
     names = snap._names
@@ -623,6 +636,7 @@ def incremental_cycle(
         dirty_lanes=n_lanes_total,
         refold_lanes=refold_lanes,
         skipped_servers=int(n_srv - len(wb_pos)),
+        scanned_servers=int(getattr(snap, "scan_scanned", n_srv)),
     )
     n = 0
     for kind_name in _KIND_NAMES:

@@ -38,7 +38,11 @@ from operator import attrgetter
 
 import numpy as np
 
-from inferno_tpu.config.defaults import MAX_QUEUE_TO_BATCH_RATIO, env_int
+from inferno_tpu.config.defaults import (
+    MAX_QUEUE_TO_BATCH_RATIO,
+    env_int,
+    rate_within_tolerance,
+)
 
 # -- incremental dirty-scan codes (ISSUE-13) ----------------------------------
 # Per-server verdicts of `FleetSnapshot.scan_update`, ordered by how much
@@ -107,6 +111,7 @@ class _Kind:
         self.lanes: list[tuple[str, str]] = []  # all static lanes, unmasked
         self.rows_per_server: np.ndarray = np.zeros(0, np.int64)
         self.lane_server: np.ndarray = np.zeros(0, np.int64)  # row -> server idx
+        self.row_starts: np.ndarray = np.zeros(1, np.int64)
         # load-dependent state of the last update; mask=None marks the
         # masked-lane cache void (fresh table or just-repacked structure)
         self.dyn: dict[str, np.ndarray] = {}
@@ -133,6 +138,12 @@ class _Kind:
         )
         self.lane_server = np.repeat(
             np.arange(len(names), dtype=np.int64), self.rows_per_server
+        )
+        # per-server row extents (server i owns rows
+        # [row_starts[i], row_starts[i+1])) — the event-dirty sparse
+        # update indexes lane rows by position through this
+        self.row_starts = np.concatenate(
+            ([0], np.cumsum(self.rows_per_server))
         )
         # the lane list just changed; an equal-CONTENT mask from the
         # previous structure must not keep its masked_lanes (two fleets
@@ -213,6 +224,14 @@ class FleetSnapshot:
         self._scan: _ScanState | None = None
         self.scan_codes: np.ndarray | None = None
         self.scan_all_dirty = True
+        # servers whose content the last scan actually READ (poll scan:
+        # the whole fleet; event scan: just the dirty set) — the
+        # event-reconcile bench's scanned-work axis
+        self.scan_scanned = 0
+        # name -> position map, rebuilt lazily when _names is replaced
+        # (identity-checked: scan-scale fleets reuse the same list)
+        self._pos_map: dict[str, int] = {}
+        self._pos_names: list[str] | None = None
 
     # -- structural layer ---------------------------------------------------
 
@@ -668,6 +687,7 @@ class FleetSnapshot:
             self._fresh_scan_state(system, names, servers, cap_fp, class_fp)
             self.scan_codes = np.full(n, SCAN_FULL, np.int8)
             self.scan_all_dirty = True
+            self.scan_scanned = n
             return version
         st.cap_fp = cap_fp
         if class_fp is not None:  # rebuilt-but-equal classes: refresh witness
@@ -854,6 +874,180 @@ class FleetSnapshot:
 
         self.scan_codes = codes
         self.scan_all_dirty = False
+        self.scan_scanned = n
+        return self.version
+
+    def _position_index(self) -> dict[str, int]:
+        if self._pos_names is not self._names:
+            self._pos_map = {n: i for i, n in enumerate(self._names)}
+            self._pos_names = self._names
+        return self._pos_map
+
+    def scan_event_update(
+        self,
+        system,
+        dirty_names,
+        lam_tolerance: float = 0.0,
+    ) -> int:
+        """Event-authoritative variant of `scan_update` (ISSUE-20): the
+        caller asserts — on the authority of its event source (watch
+        streams + grouped-collector λ deltas) — that ONLY the servers in
+        `dirty_names` changed since the previous scan. The O(fleet)
+        content diff is skipped: only the named servers are re-read, and
+        the table's sole arrival-dependent dynamic column (the per-lane
+        rate) is rewritten sparsely, O(dirty lanes).
+
+        Decision-surface parity with the poll scan is exact by
+        construction: the same per-server comparisons run (structure
+        signature, token mix, eligibility, the shared λ-tolerance
+        predicate, the current-allocation value triple), and the sparse
+        rate write computes the identical f64 expression `arrival / 60`
+        the vectorized `_apply_load` would. Anything this path cannot
+        prove it can update sparsely FALLS BACK to a full `scan_update`
+        (poll-equivalent, hence parity-safe):
+
+        * no prior scan state / fleet size changed / unknown dirty name
+          (membership changed under us),
+        * catalog / capacity / quota / spot / service-class fingerprint
+          moved (global context),
+        * a dirty server's structure signature changed (lane set may
+          repack),
+        * token mix, eligibility, or load-presence changed (masks and
+          batch rescale depend on them),
+        * a λ move on a non-eligible server (the poll path classifies it
+          FULL).
+
+        The event source is trusted only for *which* servers changed —
+        every claim about *what* changed is re-verified against the
+        anchors, so a mislabeled event degrades to extra work, never to
+        a wrong verdict. Drift from missed events (the one thing this
+        path cannot see) is bounded by the caller's periodic anti-entropy
+        full scan (EVENT_ANTI_ENTROPY_CYCLES).
+
+        λ anchoring within `lam_tolerance` matches the poll scan; the
+        `max_age_cycles` streak re-anchor is intentionally NOT advanced
+        here (an event cycle re-reads only the dirty servers, so
+        fleet-wide drift streaks would undercount) — age-based expiry
+        happens on the anti-entropy pass.
+        """
+        st = self._scan
+        n = len(self._names)
+        if (
+            st is None
+            or not self._load
+            or n == 0
+            or len(system.servers) != n
+        ):
+            return self.scan_update(system, lam_tolerance)
+        cap_fp = self._cap_fp(system)
+        class_fp = None
+        doubt = cap_fp != st.cap_fp
+        if not doubt and tuple(system.service_classes.values()) != st.class_wit:
+            class_fp = self._class_fp(system)
+            doubt = class_fp != st.class_fp
+        if doubt:
+            return self.scan_update(system, lam_tolerance)
+        st.cap_fp = cap_fp
+        if class_fp is not None:
+            st.class_wit = tuple(system.service_classes.values())
+            st.class_fp = class_fp
+
+        pos_map = self._position_index()
+        servers_map = system.servers
+        sigs = self._sigs
+        # pass 1 — VALIDATE every dirty claim without mutating anchors:
+        # a mid-loop fallback after partial anchor updates would make the
+        # full scan classify already-anchored movers CLEAN while the lane
+        # table still holds their old rate
+        rate_upd: dict[int, float] = {}
+        cur_upd: dict[int, tuple] = {}
+        seen: dict[int, object] = {}
+        for name in dirty_names:
+            pos = pos_map.get(name)
+            server = servers_map.get(name)
+            if pos is None or server is None:
+                return self.scan_update(system, lam_tolerance)
+            if sigs.get(name) != _structure_sig(system, server):
+                return self.scan_update(system, lam_tolerance)
+            load = server.load
+            if load is None:
+                arrival_i, in_i, out_i = np.nan, 0.0, 0.0
+            else:
+                arrival_i = load.arrival_rate
+                in_i = load.avg_in_tokens
+                out_i = load.avg_out_tokens
+            normal_i = (
+                not np.isnan(arrival_i) and arrival_i > 0
+                and in_i >= 0 and out_i > 0
+            )
+            tok_same = (
+                (in_i == st.in_tok[pos]
+                 or (np.isnan(in_i) and np.isnan(st.in_tok[pos])))
+                and (out_i == st.out_tok[pos]
+                     or (np.isnan(out_i) and np.isnan(st.out_tok[pos])))
+            )
+            if (
+                not tok_same
+                or normal_i != bool(st.normal[pos])
+                or np.isnan(arrival_i) != np.isnan(st.arrival[pos])
+            ):
+                return self.scan_update(system, lam_tolerance)
+            if not np.isnan(arrival_i):
+                anchor = float(st.arrival[pos])
+                if not rate_within_tolerance(anchor, arrival_i, lam_tolerance):
+                    if not normal_i:
+                        # poll classifies a non-eligible λ move FULL
+                        return self.scan_update(system, lam_tolerance)
+                    rate_upd[pos] = arrival_i
+            cur = server.cur_allocation
+            cv = (cur.accelerator, cur.cost, cur.num_replicas)
+            if cv != st.cur_vals[pos]:
+                cur_upd[pos] = cv
+            seen[pos] = server
+
+        # pass 2 — APPLY: anchors, witnesses, verdicts, sparse table write
+        codes = np.zeros(n, np.int8)
+        for pos, server in seen.items():
+            st.server_objs[pos] = server
+            st.model_names[pos] = server.model_name
+            st.model_objs[pos] = system.models.get(server.model_name)
+            st.cur_objs[pos] = server.cur_allocation
+        for pos, cv in cur_upd.items():
+            st.cur_vals[pos] = cv
+            codes[pos] = SCAN_VALUE
+        if rate_upd:
+            pos_arr = np.asarray(sorted(rate_upd), np.int64)
+            vals = np.asarray([rate_upd[p] for p in sorted(rate_upd)], np.float64)
+            codes[pos_arr] = SCAN_RATE
+            st.arrival[pos_arr] = vals
+            arr_load = self._load["arrival"]
+            if arr_load is not st.arrival:  # distinct since the last update()
+                arr_load[pos_arr] = vals
+            # the ONLY arrival-dependent dynamic column is the per-lane
+            # rate (_apply_load: batch / tokens / masks depend on token
+            # mix + eligibility, both proven unchanged above) — rewrite
+            # just the dirty servers' rows. All selected servers are
+            # eligible (normal), so every row gets arr/60 exactly as the
+            # vectorized `np.where(normal, arr, 0) / 60` would.
+            for kind, prefix in ((self._agg, "agg"), (self._tan, "tan")):
+                if not len(kind.lane_server):
+                    continue
+                counts = kind.rows_per_server[pos_arr]
+                total = int(counts.sum())
+                if not total:
+                    continue
+                base = np.repeat(kind.row_starts[pos_arr], counts)
+                offs = np.arange(total, dtype=np.int64) - np.repeat(
+                    np.cumsum(counts) - counts, counts
+                )
+                kind.dyn[f"{prefix}_rate"][base + offs] = (
+                    np.repeat(vals, counts) / 60.0
+                )
+            self.version += 1
+
+        self.scan_codes = codes
+        self.scan_all_dirty = False
+        self.scan_scanned = len(seen)
         return self.version
 
     def reset(self) -> None:

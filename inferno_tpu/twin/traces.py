@@ -191,6 +191,92 @@ def heavy_tail(rate_rps: float, duration_s: float, seed: int = 0) -> TwinTrace:
     return TwinTrace("heavy_tail", seed, duration_s, arr, i, o)
 
 
+@dataclasses.dataclass(frozen=True)
+class FlashEnvelope:
+    """The SHARED burst envelope of a correlated flash crowd: `windows`
+    are `(start_s, width_s)` spike intervals, disjoint and sorted;
+    inside a window every variant's rate is `spike_scale`x its base,
+    outside it is 1x. One envelope drives a whole fleet, which is what
+    makes the crowd *correlated*: a news event hits every variant's
+    traffic in the same seconds, unlike independent `flash_crowd` traces
+    whose spikes land at per-variant random instants.
+
+    At million-variant scale the envelope is the usable artifact — the
+    event-storm bench scales per-variant base rates by `multiplier_at`
+    rather than materializing a million request traces."""
+
+    seed: int
+    duration_s: float
+    spike_scale: float
+    windows: tuple[tuple[float, float], ...]
+
+    def multiplier_at(self, t_s: float) -> float:
+        """The fleet-wide rate multiplier at horizon time `t_s`."""
+        for start, width in self.windows:
+            if start <= t_s < start + width:
+                return self.spike_scale
+        return 1.0
+
+    def phases(self, rate_rps: float) -> RateSpec:
+        """The envelope as a piecewise schedule at a given base rate —
+        the same shape `flash_crowd` builds, with these exact windows."""
+        out: list[tuple[float, float]] = []
+        t = 0.0
+        for start, width in self.windows:
+            if start > t:
+                out.append((start - t, rate_rps))
+            out.append((width, self.spike_scale * rate_rps))
+            t = start + width
+        if t < self.duration_s:
+            out.append((self.duration_s - t, rate_rps))
+        return RateSpec(tuple(out))
+
+
+def flash_envelope(
+    duration_s: float, seed: int = 0,
+    spikes: int = 3, spike_scale: float = 6.0,
+) -> FlashEnvelope:
+    """A seeded shared burst envelope: `spikes` disjoint windows, each
+    5% of the horizon, at seeded random instants (the same window
+    construction `flash_crowd` uses for a single trace)."""
+    rng = np.random.default_rng(seed)
+    width = 0.05 * duration_s
+    starts = np.sort(rng.uniform(0.0, duration_s - width, size=spikes))
+    windows: list[tuple[float, float]] = []
+    t = 0.0
+    for s in starts:
+        start = max(t, float(s))
+        if start + width > duration_s:
+            break
+        windows.append((start, width))
+        t = start + width
+    return FlashEnvelope(seed, duration_s, spike_scale, tuple(windows))
+
+
+def correlated_flash_crowds(
+    n_variants: int, rate_rps: float, duration_s: float, seed: int = 0,
+    spikes: int = 3, spike_scale: float = 6.0,
+) -> tuple[FlashEnvelope, list[TwinTrace]]:
+    """Correlated flash crowds ACROSS variants: one shared envelope
+    (seeded from `seed`) scales N otherwise-independent Poisson traces.
+    Every variant spikes in the same windows; the request-level
+    realizations stay independent (per-variant member seeds from the
+    flash_crowd ensemble convention, so no two variants — and no
+    (variant, single-trace) pair — share a raw seed)."""
+    env = flash_envelope(duration_s, seed, spikes=spikes,
+                         spike_scale=spike_scale)
+    schedule_cache: RateSpec = env.phases(rate_rps)
+    traces: list[TwinTrace] = []
+    for member_seed in trace_ensemble_seeds("flash_crowd", seed, n_variants):
+        rng = np.random.default_rng(member_seed)
+        arr = _poisson_arrivals(rng, schedule_cache, duration_s)
+        i, o = _tokens(rng, len(arr), SHAREGPT_INPUT, SHAREGPT_OUTPUT)
+        traces.append(
+            TwinTrace("correlated_flash", member_seed, duration_s, arr, i, o)
+        )
+    return env, traces
+
+
 TRACES = {
     "steady": steady,
     "ramp_burst": ramp_burst,

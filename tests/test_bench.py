@@ -448,6 +448,29 @@ def test_reconcile_cycle_bench_smoke():
     assert "miniprom" in block["provenance"]
 
 
+def test_event_reconcile_bench_smoke():
+    """The ISSUE-20 event-driven benchmark at toy scale: the event path
+    reads a fraction of the poll loop's servers on the same traffic,
+    decisions match the full solve exactly (the bench RAISES on
+    divergence), and the block carries the perfdiff-gated keys with
+    their warm-repeat noise bands. The 1M-scale latency/reduction
+    asserts only arm at full scale (make bench-event runs the honest
+    version)."""
+    block = bench.event_reconcile_bench(
+        n_variants=400, steady_cycles=3, warmup_cycles=2, single_events=6
+    )
+    assert block["parity"]["decision_mismatches"] == 0
+    assert block["parity"]["servers_compared"] == 400
+    assert block["event_scanned_servers"] < block["poll_scanned_servers"]
+    assert block["work_reduction_x"] > 1
+    assert block["queue"]["marks"] > 0
+    assert block["event_p99_latency_ms"] > 0
+    assert "event_p99_latency_ms_spread" in block
+    assert "event_steady_ms_spread" in block
+    assert block["storm"]["dirty_servers"] > 0
+    assert "DirtyQueue" in block["provenance"]
+
+
 def test_flight_recorder_bench_smoke():
     """The ISSUE-10 recorder benchmark at toy scale: recording drops
     nothing, the artifact replays with parity at every sampled cycle,

@@ -710,6 +710,145 @@ def test_reconciler_publishes_dirty_metrics():
     assert sets[0]["variant_name"] == "llama-premium"
 
 
+# -- event-authoritative scan (ISSUE-20) --------------------------------------
+
+
+def _warm(n=60, shapes=2):
+    spec = fleet_system_spec(n, shapes_per_variant=shapes)
+    system = System(spec)
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    return spec, system, list(system.servers)
+
+
+def test_event_scan_reads_only_named_servers():
+    """The whole point of the event path: at 1%-events traffic the scan
+    reads O(dirty) servers, not O(fleet) — and the decision surface
+    matches the full solve exactly."""
+    rng = np.random.default_rng(20)
+    spec, system, names = _warm()
+    moved = []
+    for name in (names[3], names[17], names[41]):
+        load = system.servers[name].load
+        if load is not None and load.arrival_rate > 0:
+            load.arrival_rate *= float(rng.uniform(1.2, 1.6))
+            moved.append(name)
+    assert moved
+    calculate_fleet(system, backend="jax", event_dirty=moved)
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    assert fd.scanned_servers == len(moved)  # NOT the fleet
+    assert fd.skipped_servers == len(names) - len(fd.dirty_pos)
+    dirty_names = {names[p] for p in fd.dirty_pos.tolist()}
+    assert dirty_names == set(moved)
+    ref = _reference(system, spec)
+    _assert_parity(_decisions(system), _decisions(ref))
+
+
+def test_event_scan_empty_set_replays_everything():
+    """An empty-but-authoritative drain ("no events") re-solves nothing:
+    allocation OBJECTS stand, zero servers read."""
+    _, system, names = _warm()
+    allocs0 = {n: s.allocation for n, s in system.servers.items()}
+    calculate_fleet(system, backend="jax", event_dirty=[])
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    assert fd.scanned_servers == 0
+    assert len(fd.dirty_pos) == 0
+    for name, server in system.servers.items():
+        assert server.allocation is allocs0[name], name
+
+
+def test_event_scan_unknown_name_falls_back_to_full():
+    """A dirty name the table has never seen means membership changed
+    under the event source: the claim is unprovable, the cycle degrades
+    to the poll scan (extra work, never a wrong verdict)."""
+    spec, system, names = _warm()
+    load = system.servers[names[5]].load
+    load.arrival_rate *= 1.5
+    calculate_fleet(
+        system, backend="jax", event_dirty=[names[5], "ghost:nowhere"]
+    )
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    assert fd.scanned_servers == len(names)  # full poll scan ran
+    ref = _reference(system, spec)
+    _assert_parity(_decisions(system), _decisions(ref))
+
+
+def test_event_scan_token_mix_change_falls_back_to_full():
+    """The sparse path only handles λ-only moves: a dirty server whose
+    token mix ALSO changed (masks and batch rescale depend on it) routes
+    the whole cycle through the poll scan, classified FULL there."""
+    spec, system, names = _warm()
+    load = system.servers[names[7]].load
+    load.arrival_rate *= 1.4
+    load.avg_out_tokens += 32.0
+    calculate_fleet(system, backend="jax", event_dirty=[names[7]])
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    assert fd.scanned_servers == len(names)
+    ref = _reference(system, spec)
+    _assert_parity(_decisions(system), _decisions(ref))
+
+
+def test_event_scan_lambda_tolerance_anchors():
+    """Sub-tolerance λ jitter on a REPORTED dirty name re-solves nothing
+    (the shared rate_within_tolerance predicate, same as the poll scan);
+    past the tolerance the same server goes RATE-dirty."""
+    _, system, names = _warm()
+    target = next(
+        n for n in names
+        if system.servers[n].load is not None
+        and system.servers[n].load.arrival_rate > 0
+    )
+    alloc0 = system.servers[target].allocation
+    load = system.servers[target].load
+    anchor = load.arrival_rate
+
+    load.arrival_rate = anchor * 1.01  # inside a 5% tolerance
+    calculate_fleet(
+        system, backend="jax", event_dirty=[target], lam_tolerance=0.05
+    )
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    assert len(fd.dirty_pos) == 0
+    assert fd.scanned_servers == 1  # read, verified, anchored
+    assert system.servers[target].allocation is alloc0
+
+    load.arrival_rate = anchor * 1.2  # past the tolerance: RATE
+    calculate_fleet(
+        system, backend="jax", event_dirty=[target], lam_tolerance=0.05
+    )
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    assert {names[p] for p in fd.dirty_pos.tolist()} == {target}
+
+
+def test_event_scan_missed_event_caught_by_next_full_scan():
+    """What the event path CANNOT see — a mutation nobody reported — is
+    exactly what the anti-entropy poll scan exists for: the event cycle
+    legitimately misses it, the next full scan catches it."""
+    spec, system, names = _warm()
+    silent = next(
+        n for n in names
+        if system.servers[n].load is not None
+        and system.servers[n].load.arrival_rate > 0
+    )
+    system.servers[silent].load.arrival_rate *= 1.5
+    # event cycle with an unrelated (clean) report: the mover is unseen
+    calculate_fleet(system, backend="jax", event_dirty=[])
+    solve_unlimited(system)
+    assert len(system.fleet_dirty.dirty_pos) == 0  # drift, by design
+    # anti-entropy: the full poll scan classifies the silent mover
+    calculate_fleet(system, backend="jax")
+    solve_unlimited(system)
+    fd = system.fleet_dirty
+    assert {names[p] for p in fd.dirty_pos.tolist()} == {silent}
+    ref = _reference(system, spec)
+    _assert_parity(_decisions(system), _decisions(ref))
+
+
 def test_no_slow_marker_in_this_module():
     """Every test here must run in the tier-1 (not slow) suite: the
     incremental path is default-on and its parity contract must gate

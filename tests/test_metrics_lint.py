@@ -18,9 +18,11 @@ def test_production_catalog_is_clean():
     # six cycle-profiler series (phase wall/CPU histograms, burn gauge,
     # event + ms counters, memory high-water gauge), the three
     # incremental dirty-set series (dirty-lane/skipped-server counters,
-    # per-variant dirty marker gauge), and the three fleet-twin progress
-    # series (event counter, virtual-ms counter, pool-size gauge)
-    assert len(names) == 34
+    # per-variant dirty marker gauge), the three fleet-twin progress
+    # series (event counter, virtual-ms counter, pool-size gauge), and
+    # the two event-driven reconcile series (dirty-queue depth gauge,
+    # per-shard owned-variant gauge)
+    assert len(names) == 36
     assert {"inferno_desired_replicas", "inferno_cycle_duration_seconds",
             "inferno_variant_analysis_seconds", "inferno_solver_seconds",
             "inferno_prom_scrape_seconds"} <= names
@@ -57,6 +59,34 @@ def test_forecast_series_in_catalog():
         assert kind == "gauge"
         assert help_.strip()
         assert name.startswith("inferno_")
+
+
+def test_event_series_in_catalog():
+    """The ISSUE-20 event-driven reconcile series register
+    unconditionally (whether or not the controller runs event-driven or
+    sharded) and ride the same prefix + help enforcement."""
+    registry = build_controller_registry()
+    catalog = {name: (help_, kind) for name, help_, kind in registry.catalog()}
+    for name in ("inferno_event_queue_depth", "inferno_shard_owned_servers"):
+        assert name in catalog, name
+        help_, kind = catalog[name]
+        assert kind == "gauge"
+        assert help_.strip()
+        assert name.startswith("inferno_")
+
+
+def test_event_instruments_observe():
+    """observe_drain/observe_shard publish through the registry with the
+    shard label carrying the member name."""
+    from inferno_tpu.controller.metrics import EventInstruments
+
+    inst = EventInstruments(Registry())
+    inst.observe_drain(7)
+    assert inst.queue_depth.get({}) == 7.0
+    inst.observe_shard("ctrl-0", 512)
+    inst.observe_shard("ctrl-1", 488)
+    assert inst.shard_owned.get({"shard": "ctrl-0"}) == 512.0
+    assert inst.shard_owned.get({"shard": "ctrl-1"}) == 488.0
 
 
 def test_incremental_dirty_series_in_catalog():
@@ -179,9 +209,12 @@ def test_lint_enforces_unit_suffix_with_allowlist():
     assert "unit suffix" in violations[0]
     # the allowlist is a closed, known set — additions need a
     # contract-level reason, so pin its membership here
+    # (inferno_event_queue_depth: ISSUE-20 event reconcile, named after
+    # controller-runtime's conventional workqueue_depth)
     assert UNIT_SUFFIX_ALLOWLIST == {
         "inferno_desired_replicas", "inferno_current_replicas",
         "inferno_sizing_cache_lookups", "inferno_collect_concurrency",
+        "inferno_event_queue_depth",
     }
 
 

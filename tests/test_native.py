@@ -390,3 +390,154 @@ def test_near_saturation_lanes_match_scalar():
             # in the optimized argmax/underflow path would shift these
             assert got == expect, (f, got, expect)
         assert out.rate_star[i] == pytest.approx(lam, rel=2e-3), f
+
+
+# -- λ-only refold (ISSUE-20) --------------------------------------------------
+
+
+def _f32_params(n_lanes=32, seed=17):
+    params = make_params(n_lanes=n_lanes, seed=seed)
+    return FleetParams(
+        *(
+            np.asarray(a, np.float32) if a.dtype == np.float64 else a
+            for a in params
+        )
+    )
+
+
+def _op_point_close(jax_val, native_val, what):
+    """itl/ttft/rho within 1e-4 relative, with a 1e-6 msec absolute floor
+    for values that are pure floating-point dust (a zero-queue wait is
+    ~1e-12 msec and cancels differently in f32 vs f64)."""
+    j = np.asarray(jax_val, np.float64)
+    bad = np.abs(j - native_val) > np.maximum(1e-4 * np.abs(j), 1e-6)
+    assert not bad.any(), (what, j[bad], native_val[bad])
+
+
+def test_fleet_refold_matches_jax_refold():
+    """The native λ-only refold against the jax refold from the SAME
+    cached bisection: decision surface (replicas, cost) bit-identical —
+    both sides run the identical f32 divide/ceil/int32/multiply — and the
+    operating point within the documented 1e-4 relative tolerance."""
+    from inferno_tpu.ops.queueing import fleet_refold, fleet_size
+
+    rng = np.random.default_rng(23)
+    params = _f32_params(n_lanes=32, seed=17)
+    k_max = int(params.occupancy_cap.max())
+    full = fleet_size(params, k_max)
+    bumped = params._replace(
+        total_rate=(
+            params.total_rate * rng.uniform(0.3, 3.0, 32).astype(np.float32)
+        )
+    )
+    jref = fleet_refold(
+        bumped, k_max, full.lambda_star, full.rate_star, full.feasible
+    )
+    nref = native.fleet_refold_native(
+        bumped, np.asarray(full.lambda_star), np.asarray(full.rate_star),
+        np.asarray(full.feasible),
+    )
+    np.testing.assert_array_equal(np.asarray(jref.feasible), nref.feasible)
+    np.testing.assert_array_equal(
+        np.asarray(jref.num_replicas), nref.num_replicas
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jref.cost, np.float64), nref.cost
+    )
+    # the cached bisection must pass through untouched
+    np.testing.assert_array_equal(
+        np.asarray(full.lambda_star, np.float64), nref.lambda_star
+    )
+    np.testing.assert_array_equal(
+        np.asarray(full.rate_star, np.float64), nref.rate_star
+    )
+    _op_point_close(jref.itl, nref.itl, "itl")
+    _op_point_close(jref.ttft, nref.ttft, "ttft")
+    _op_point_close(jref.rho, nref.rho, "rho")
+
+
+def test_tandem_refold_matches_jax_refold():
+    """Disaggregated analogue: native tandem refold vs ops.queueing's
+    tandem_refold — same exact-decision-surface / 1e-4 operating-point
+    contract."""
+    from inferno_tpu.ops.queueing import (
+        TandemParams, tandem_fleet_size, tandem_refold,
+    )
+
+    rng = np.random.default_rng(29)
+    n = 24
+    pb = rng.choice([8, 16], n).astype(np.int32)
+    db = rng.choice([16, 48], n).astype(np.int32)
+    params = TandemParams(
+        alpha=rng.uniform(5, 30, n).astype(np.float32),
+        beta=rng.uniform(0.05, 0.5, n).astype(np.float32),
+        gamma=rng.uniform(20, 80, n).astype(np.float32),
+        delta=rng.uniform(0.001, 0.01, n).astype(np.float32),
+        in_tokens=rng.uniform(64, 512, n).astype(np.float32),
+        out_tokens=rng.uniform(32, 256, n).astype(np.float32),
+        prefill_batch=pb, decode_batch=db,
+        prefill_cap=(pb * 10).astype(np.int32),
+        decode_cap=(db * 10).astype(np.int32),
+        prefill_slices=rng.choice([1.0, 2.0], n).astype(np.float32),
+        decode_slices=rng.choice([1.0, 4.0], n).astype(np.float32),
+        target_ttft=rng.choice([0.0, 2000.0, 5000.0], n).astype(np.float32),
+        target_itl=rng.uniform(40, 120, n).astype(np.float32),
+        target_tps=rng.choice([0.0, 0.0, 500.0], n).astype(np.float32),
+        total_rate=rng.uniform(0, 40, n).astype(np.float32),
+        min_replicas=rng.choice([0, 1, 3], n).astype(np.int32),
+        cost_per_replica=rng.uniform(5, 40, n).astype(np.float32),
+    )
+    k_max = int(max(params.prefill_cap.max(), params.decode_cap.max()))
+    full = tandem_fleet_size(params, k_max)
+    bumped = params._replace(
+        total_rate=(
+            params.total_rate * rng.uniform(0.3, 3.0, n).astype(np.float32)
+        )
+    )
+    jref = tandem_refold(
+        bumped, k_max, full.lambda_star, full.rate_star, full.feasible
+    )
+    nref = native.tandem_refold_native(
+        bumped, np.asarray(full.lambda_star), np.asarray(full.rate_star),
+        np.asarray(full.feasible),
+    )
+    np.testing.assert_array_equal(np.asarray(jref.feasible), nref.feasible)
+    np.testing.assert_array_equal(
+        np.asarray(jref.num_replicas), nref.num_replicas
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jref.cost, np.float64), nref.cost
+    )
+    _op_point_close(jref.itl, nref.itl, "itl")
+    _op_point_close(jref.ttft, nref.ttft, "ttft")
+    _op_point_close(jref.rho, nref.rho, "rho")
+
+
+def test_fleet_refold_invalid_lane_rejected_not_crashing():
+    """A lane that fails input validation (or carries a non-positive
+    cached rate_star) zeroes out instead of dividing by it."""
+    params = _f32_params(n_lanes=3, seed=31)
+    bad = params._replace(max_batch=np.array([0, 8, 8], np.int32))
+    rate = np.array([10.0, 0.0, 10.0])
+    out = native.fleet_refold_native(
+        bad, np.full(3, 1.0), rate, np.ones(3, np.uint8)
+    )
+    assert not out.feasible[0] and out.num_replicas[0] == 0  # invalid lane
+    assert not out.feasible[1] and out.num_replicas[1] == 0  # rate_star 0
+    assert out.num_replicas[2] > 0
+
+
+def test_fleet_refold_threaded_matches_sequential():
+    from inferno_tpu.ops.queueing import fleet_size
+
+    params = _f32_params(n_lanes=48, seed=37)
+    k_max = int(params.occupancy_cap.max())
+    full = fleet_size(params, k_max)
+    lam = np.asarray(full.lambda_star)
+    rate = np.asarray(full.rate_star)
+    feas = np.asarray(full.feasible)
+    seq = native.fleet_refold_native(params, lam, rate, feas, n_threads=1)
+    par = native.fleet_refold_native(params, lam, rate, feas, n_threads=4)
+    np.testing.assert_array_equal(seq.num_replicas, par.num_replicas)
+    np.testing.assert_array_equal(seq.cost, par.cost)
+    np.testing.assert_array_equal(seq.ttft, par.ttft)

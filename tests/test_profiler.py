@@ -366,6 +366,11 @@ def test_perfdiff_extracts_all_three_source_shapes():
                         "incremental_steady_ms_spread": 8.0,
                         "incremental_cold_ms": 8200.0,
                         "incremental_all_rate_ms": 3000.0},
+        "event": {"event_p99_latency_ms": 180.0,
+                  "event_p99_latency_ms_spread": 25.0,
+                  "event_steady_ms": 60.0, "event_steady_ms_spread": 9.0,
+                  "poll_steady_ms": 190.0,
+                  "storm": {"enter_ms": 5000.0, "exit_ms": 3500.0}},
     }
     m = perfdiff.extract_metrics(full)
     assert m["cycle_ms"] == {"value": 300.0, "spread": 30.0}
@@ -383,6 +388,12 @@ def test_perfdiff_extracts_all_three_source_shapes():
     # compact-line aliases join the BENCH_r trajectory
     assert m["incr_steady_ms"]["value"] == 90.0
     assert m["incr_cold_ms"]["value"] == 8200.0
+    # ISSUE-20: the event deliverables gate with their noise bands;
+    # the poll baseline and unrepeated storm points do NOT
+    assert m["event_p99_latency_ms"] == {"value": 180.0, "spread": 25.0}
+    assert m["event_steady_ms"] == {"value": 60.0, "spread": 9.0}
+    assert m["event_p99_ms"]["value"] == 180.0
+    assert "poll_steady_ms" not in m and "storm_enter_ms" not in m
 
     live = {"cycles": [_profile_cycle(100, 20, 10),
                        _profile_cycle(120, 30, 14),

@@ -382,6 +382,57 @@ def test_closed_loop_matches_tandem_analyzer_twin():
         mean_itl, pred.avg_token_time)
 
 
+# -- correlated flash crowds (ISSUE-20) ---------------------------------------
+
+
+def test_correlated_flash_crowd_shares_one_envelope():
+    """One burst envelope drives all N variants: every trace's arrival
+    rate inside the shared spike windows is several times its
+    outside-window rate — the spikes land in the SAME seconds, which is
+    the correlation independent `flash_crowd` traces don't have."""
+    from inferno_tpu.twin.traces import correlated_flash_crowds
+
+    env, traces = correlated_flash_crowds(
+        6, rate_rps=8.0, duration_s=120.0, seed=3, spikes=2,
+        spike_scale=6.0,
+    )
+    assert len(traces) == 6
+    assert len(env.windows) == 2
+    assert len({t.seed for t in traces}) == 6  # independent realizations
+    spike_s = sum(w for _, w in env.windows)
+    base_s = env.duration_s - spike_s
+    for t in traces:
+        arr_s = t.arr_ms / 1000.0
+        in_spike = np.zeros(len(arr_s), dtype=bool)
+        for start, width in env.windows:
+            in_spike |= (arr_s >= start) & (arr_s < start + width)
+        spike_rate = in_spike.sum() / spike_s
+        base_rate = (~in_spike).sum() / base_s
+        # 6x programmed ratio, generously banded for Poisson noise
+        assert spike_rate > 3.0 * base_rate, t.seed
+    # the envelope multiplier agrees with its own windows
+    start0 = env.windows[0][0]
+    assert env.multiplier_at(start0 + 0.01) == 6.0
+    assert env.multiplier_at(env.duration_s - 1e-6) in (1.0, 6.0)
+
+
+def test_correlated_flash_crowd_deterministic():
+    """Pure function of (n, rate, duration, seed): same arguments, bit
+    identical traces and envelope — the property every twin generator
+    holds (and the storm bench's reproducibility depends on)."""
+    from inferno_tpu.twin.traces import correlated_flash_crowds
+
+    a_env, a = correlated_flash_crowds(3, 5.0, 60.0, seed=9)
+    b_env, b = correlated_flash_crowds(3, 5.0, 60.0, seed=9)
+    assert a_env == b_env
+    for x, y in zip(a, b):
+        assert np.array_equal(x.arr_ms, y.arr_ms)
+        assert np.array_equal(x.in_tokens, y.in_tokens)
+        assert np.array_equal(x.out_tokens, y.out_tokens)
+    c_env, _ = correlated_flash_crowds(3, 5.0, 60.0, seed=10)
+    assert c_env.windows != a_env.windows
+
+
 # -- meta ---------------------------------------------------------------------
 
 

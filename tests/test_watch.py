@@ -9,7 +9,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from inferno_tpu.controller.kube import InMemoryCluster
 from inferno_tpu.controller.reconciler import Reconciler, ReconcilerConfig
-from inferno_tpu.controller.watch import WATCHED_CONFIGMAPS, Watcher
+from inferno_tpu.controller.watch import (
+    WATCHED_CONFIGMAPS,
+    DirtyQueue,
+    Watcher,
+)
 
 from test_controller import CFG_NS, make_cluster, make_prom
 
@@ -321,3 +325,172 @@ def test_va_event_type_filter():
     assert woke == []
     w._on_va_event("ADDED")
     assert woke == [1]
+
+
+# -- DirtyQueue: coalescing dirty sets (ISSUE-20) -----------------------------
+
+
+class _Clock:
+    """Deterministic injected clock: the debounce window advances only
+    when the test says so (INF005: no free-running waits)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_dirty_queue_drain_sorted_and_empties():
+    q = DirtyQueue(wake=None, debounce_s=0.0, anti_entropy_cycles=1000)
+    q.mark(["b:ns", "a:ns"], wake=False)
+    q.mark(["a:ns"], wake=False)  # re-mark coalesces into one entry …
+    assert q.depth() == 2
+    assert q.marks == 3  # … but the mark COUNTER sees every event
+    assert q.drain() == ["a:ns", "b:ns"]
+    assert q.depth() == 0
+    # empty is still authoritative: "no events" means "nothing moved",
+    # not "run the full scan"
+    assert q.drain() == []
+
+
+def test_dirty_queue_wake_debounce_leading_edge():
+    clock = _Clock()
+    woke = []
+    q = DirtyQueue(wake=lambda: woke.append(1), debounce_s=0.2,
+                   anti_entropy_cycles=1000, clock=clock)
+    q.mark(["a"])  # leading edge: the first mark of a quiet period fires
+    q.mark(["b"])
+    q.mark(["c"])  # inside the window: absorbed silently
+    assert woke == [1]
+    assert (q.wakes_fired, q.wakes_coalesced) == (1, 2)
+    clock.t = 0.25  # window expired: the next mark fires again
+    q.mark(["d"])
+    assert woke == [1, 1]
+    assert q.wakes_fired == 2
+    q.mark(["e"], wake=False)  # wake=False neither fires nor counts
+    assert q.wakes_fired == 2 and len(woke) == 2
+    assert q.drain() == ["a", "b", "c", "d", "e"]
+
+
+def test_dirty_queue_mark_all_forces_full_scan():
+    q = DirtyQueue(wake=None, debounce_s=0.0, anti_entropy_cycles=1000)
+    q.mark(["a"], wake=False)
+    q.mark_all(wake=False)
+    assert q.drain() is None  # non-authoritative: run the full poll scan
+    assert q.drain() == []  # the doubt is consumed by one drain
+
+
+def test_dirty_queue_anti_entropy_cadence():
+    """Every Nth drain is deliberately non-authoritative so a periodic
+    full scan bounds drift from any missed event."""
+    q = DirtyQueue(wake=None, debounce_s=0.0, anti_entropy_cycles=3)
+    outs = [q.drain() for _ in range(6)]
+    assert [o is None for o in outs] == [
+        False, False, True, False, False, True,
+    ]
+
+
+# -- watcher events feed the dirty queue (ISSUE-20) ---------------------------
+
+
+def test_va_events_mark_named_variant():
+    """Every NAMED VA event marks `name:namespace` dirty — the targeted
+    scan re-verifies the claim, so marking MODIFIED/DELETED is safe —
+    while only ADDED additionally wakes (create-only reference parity)."""
+    woke = []
+    q = DirtyQueue(wake=None, debounce_s=0.0, anti_entropy_cycles=1000)
+    w = Watcher(object(), lambda: woke.append(1),
+                config_namespace=CFG_NS, dirty=q)
+    w._on_va_event("MODIFIED", "v", "ns")
+    w._on_va_event("DELETED", "w", "ns")
+    assert woke == []  # neither wakes …
+    assert q.drain() == ["v:ns", "w:ns"]  # … but both mark
+    w._on_va_event("ADDED", "x", "ns")
+    assert woke == [1]
+    assert q.drain() == ["x:ns"]
+    w._on_va_event("BOOKMARK", "y", "ns")  # non-mutation types never mark
+    w._on_va_event("ERROR", "z", "ns")
+    assert q.drain() == []
+
+
+def test_cm_event_marks_whole_fleet_dirty():
+    """A watched-ConfigMap edit can change ANY variant's sizing inputs:
+    it marks the whole fleet (the next drain demands a full poll scan),
+    while filtered CM events leave no doubt behind."""
+    q = DirtyQueue(wake=None, debounce_s=0.0, anti_entropy_cycles=1000)
+    w = Watcher(object(), lambda: None, config_namespace=CFG_NS, dirty=q)
+    w._on_cm_event("unwatched-cm", CFG_NS)
+    w._on_cm_event(WATCHED_CONFIGMAPS[0], "elsewhere")
+    assert q.drain() == []
+    w._on_cm_event(WATCHED_CONFIGMAPS[0], CFG_NS)
+    assert q.drain() is None
+
+
+def test_va_burst_debounces_into_one_cycle():
+    """Regression (ISSUE-20 satellite): a burst of VA events inside one
+    debounce window produces ONE extra reconcile cycle — run_forever
+    absorbs the storm in the debounce sleep while the marks coalesce in
+    the queue — instead of a full reconcile per event."""
+    cluster = make_cluster(replicas=1)
+    # long interval so only wakes (never the timer) drive extra cycles
+    cluster.set_configmap(CFG_NS, "inferno-autoscaler-config",
+                          {"GLOBAL_OPT_INTERVAL": "60s"})
+    rec = Reconciler(kube=cluster, prom=make_prom(arrival_rps=5.0),
+                     config=ReconcilerConfig(config_namespace=CFG_NS,
+                                             compute_backend="scalar"))
+    # freeze the queue clock: every wake-mark after the first coalesces
+    # regardless of host scheduling
+    rec.dirty_queue.clock = lambda: 0.0
+    rec.dirty_queue.debounce_s = 0.05
+
+    burst_landed = threading.Event()
+    absorbed = []
+
+    def absorb(seconds):
+        # run_forever's debounce sleep: the rest of the burst lands
+        # while the loop sits here, then drains as ONE dirty set
+        absorbed.append(seconds)
+        burst_landed.wait(5)
+
+    rec.sleep = absorb
+
+    depths = []
+    orig = rec.run_cycle
+    rec.run_cycle = lambda: (depths.append(rec.dirty_queue.depth()),
+                             orig())[1]
+    stopping = {"stop": False}
+    t = threading.Thread(
+        target=lambda: rec.run_forever(stop_check=lambda: stopping["stop"])
+    )
+    t.start()
+    try:
+        deadline = time.time() + 5
+        while not depths and time.time() < deadline:
+            time.sleep(0.02)
+        assert depths, "first cycle never ran"
+
+        # an 8-event VA burst: the first mark pokes the loop (leading
+        # edge), the remaining 7 coalesce silently in the queue
+        for i in range(8):
+            rec.dirty_queue.mark([f"burst-{i}:ns"], wake=True)
+        assert rec.dirty_queue.wakes_fired == 1
+        assert rec.dirty_queue.wakes_coalesced == 7
+        burst_landed.set()
+
+        deadline = time.time() + 5
+        while len(depths) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(depths) >= 2, "burst cycle never ran"
+        time.sleep(0.2)  # settle: no further wake is pending
+        assert len(depths) == 2, "burst produced more than one extra cycle"
+        # the one burst cycle drained ALL 8 marks (queue may also carry
+        # the first cycle's wake-less self-marks, hence >=)
+        assert depths[1] >= 8
+        assert absorbed and absorbed[0] == rec.dirty_queue.debounce_s
+    finally:
+        stopping["stop"] = True
+        burst_landed.set()
+        rec.poke()
+        t.join(timeout=5)
+    assert not t.is_alive()
